@@ -1,0 +1,52 @@
+"""Test harness setup (SURVEY.md §4).
+
+JAX-touching tests (loadgen, sharding) run on a virtual 8-device CPU mesh so
+multi-chip code paths execute with zero TPU hardware. These env vars must be
+set before jax is first imported anywhere in the test process.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+import pytest  # noqa: E402
+
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation  # noqa: E402
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript  # noqa: E402
+from tpu_pod_exporter.metrics import SnapshotStore  # noqa: E402
+
+
+@pytest.fixture
+def store():
+    return SnapshotStore()
+
+
+@pytest.fixture
+def four_chip_backend():
+    """A v4-8-like host: 4 chips, 32 GiB HBM each, some usage."""
+    script = FakeChipScript(
+        hbm_total_bytes=32 * 1024**3,
+        hbm_used_bytes=4 * 1024**3,
+        duty_cycle_percent=50.0,
+        ici_link_count=6,
+        ici_bytes_per_step=1000.0,
+    )
+    return FakeBackend(chips=4, script=script)
+
+
+@pytest.fixture
+def one_pod_attribution():
+    """One pod owning all 4 chips (baseline config 2)."""
+    return FakeAttribution(
+        [simple_allocation("train-job-0", ["0", "1", "2", "3"], namespace="ml")]
+    )

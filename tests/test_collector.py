@@ -1,0 +1,273 @@
+"""Collector unit/behavior tests: join, labels, GC, faults, rates.
+
+Covers the reference-defect inversions (SURVEY.md §2.6): correct device-ID
+join, per-chip labels, stale-series GC, error containment.
+"""
+
+import pytest
+
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+from tpu_pod_exporter.collector import Collector, PollStats
+from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.topology import HostTopology
+
+
+def make_collector(backend, attribution, store, **kw):
+    topo = HostTopology(
+        accelerator="v4-8", slice_name="s0", host="host0", worker_id="0"
+    )
+    return Collector(backend, attribution, store, topology=topo, **kw)
+
+
+def chip_labels(chip_id, pod="", namespace="", container="", device_path=None):
+    return {
+        "chip_id": str(chip_id),
+        "device_path": device_path if device_path is not None else f"/dev/accel{chip_id}",
+        "accelerator": "v4-8",
+        "slice_name": "s0",
+        "host": "host0",
+        "worker_id": "0",
+        "pod": pod,
+        "namespace": namespace,
+        "container": container,
+    }
+
+
+class TestJoin:
+    def test_attributed_chip_carries_pod_labels(self, store, four_chip_backend, one_pod_attribution):
+        c = make_collector(four_chip_backend, one_pod_attribution, store)
+        c.poll_once()
+        snap = store.current()
+        labels = chip_labels(0, pod="train-job-0", namespace="ml", container="main")
+        assert snap.value("tpu_hbm_used_bytes", labels) == 4 * 1024**3
+        assert snap.value("tpu_hbm_total_bytes", labels) == 32 * 1024**3
+        assert snap.value("tpu_hbm_used_percent", labels) == 12.5
+        assert snap.value("tpu_tensorcore_duty_cycle_percent", labels) == 50.0
+
+    def test_unallocated_chip_has_empty_pod_labels(self, store, four_chip_backend):
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(2)) == 4 * 1024**3
+
+    def test_multi_pod_partition(self, store, four_chip_backend):
+        attr = FakeAttribution(
+            [
+                simple_allocation("pod-a", ["0", "1"], namespace="ns1"),
+                simple_allocation("pod-b", ["2", "3"], namespace="ns2", container="c2"),
+            ]
+        )
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        snap = store.current()
+        assert (
+            snap.value("tpu_hbm_used_bytes", chip_labels(1, "pod-a", "ns1", "main"))
+            is not None
+        )
+        assert (
+            snap.value("tpu_hbm_used_bytes", chip_labels(3, "pod-b", "ns2", "c2"))
+            is not None
+        )
+
+    def test_same_pod_name_different_namespace_do_not_collide(self, store):
+        # The reference keys by pod name only (main.go:113) — namespaces collide.
+        backend = FakeBackend(chips=2)
+        attr = FakeAttribution(
+            [
+                simple_allocation("job", ["0"], namespace="alpha"),
+                simple_allocation("job", ["1"], namespace="beta"),
+            ]
+        )
+        c = make_collector(backend, attr, store)
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(0, "job", "alpha", "main")) is not None
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(1, "job", "beta", "main")) is not None
+
+    def test_pod_rollups(self, store, four_chip_backend, one_pod_attribution):
+        c = make_collector(four_chip_backend, one_pod_attribution, store)
+        c.poll_once()
+        snap = store.current()
+        rollup = {
+            "pod": "train-job-0",
+            "namespace": "ml",
+            "accelerator": "v4-8",
+            "slice_name": "s0",
+            "host": "host0",
+            "worker_id": "0",
+        }
+        assert snap.value("tpu_pod_chip_count", rollup) == 4
+        assert snap.value("tpu_pod_hbm_used_bytes", rollup) == 4 * 4 * 1024**3
+
+
+class TestSeriesLifecycle:
+    def test_stale_series_gone_after_pod_exit(self, store, four_chip_backend):
+        attr = FakeAttribution([simple_allocation("ephemeral", ["0", "1", "2", "3"])])
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        assert (
+            store.current().value(
+                "tpu_hbm_used_bytes", chip_labels(0, "ephemeral", "default", "main")
+            )
+            is not None
+        )
+        attr.set_allocations([])  # pod deleted
+        c.poll_once()
+        snap = store.current()
+        assert (
+            snap.value("tpu_hbm_used_bytes", chip_labels(0, "ephemeral", "default", "main"))
+            is None
+        )
+        # chip series still exists, unattributed
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(0)) is not None
+
+    def test_reassignment_single_owner_per_chip(self, store, four_chip_backend):
+        attr = FakeAttribution([simple_allocation("a", ["0"])])
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        attr.set_allocations([simple_allocation("b", ["0"])])
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(0, "b", "default", "main")) is not None
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(0, "a", "default", "main")) is None
+        # exactly 4 hbm_used series (one per chip)
+        assert len(snap.samples("tpu_hbm_used_bytes")) == 4
+
+
+class TestFaultContainment:
+    def test_backend_failure_degrades_not_dies(self, store, four_chip_backend, one_pod_attribution):
+        c = make_collector(four_chip_backend, one_pod_attribution, store)
+        c.poll_once()
+        four_chip_backend.fail_next(1)
+        stats = c.poll_once()
+        assert not stats.ok
+        snap = store.current()
+        assert snap.value("tpu_exporter_up") == 0
+        assert snap.value("tpu_exporter_poll_errors_total", ("device_read",)) == 1
+        # recovery
+        stats = c.poll_once()
+        assert stats.ok
+        assert store.current().value("tpu_exporter_up") == 1
+
+    def test_attribution_failure_uses_last_good_within_staleness(
+        self, store, four_chip_backend, one_pod_attribution
+    ):
+        c = make_collector(
+            four_chip_backend, one_pod_attribution, store, attribution_max_stale_s=1e9
+        )
+        c.poll_once()
+        one_pod_attribution.fail_next(1)
+        c.poll_once()
+        snap = store.current()
+        # stale-but-recent attribution still applied
+        assert (
+            snap.value(
+                "tpu_hbm_used_bytes", chip_labels(0, "train-job-0", "ml", "main")
+            )
+            is not None
+        )
+        assert snap.value("tpu_exporter_poll_errors_total", ("attribution",)) == 1
+
+    def test_attribution_failure_beyond_staleness_drops_labels(
+        self, store, four_chip_backend, one_pod_attribution
+    ):
+        fake_now = [0.0]
+        c = make_collector(
+            four_chip_backend,
+            one_pod_attribution,
+            store,
+            attribution_max_stale_s=5.0,
+            clock=lambda: fake_now[0],
+        )
+        c.poll_once()
+        fake_now[0] += 10.0
+        one_pod_attribution.fail_next(1)
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(0)) is not None
+
+    def test_unexpected_exception_contained(self, store):
+        class ExplodingBackend(FakeBackend):
+            def sample(self):
+                raise RuntimeError("not a BackendError")
+
+        c = make_collector(ExplodingBackend(chips=1), FakeAttribution(), store)
+        stats = c.poll_once()
+        assert not stats.ok
+        assert store.current().value("tpu_exporter_up") == 0
+
+    def test_partial_errors_counted(self, store, four_chip_backend):
+        four_chip_backend.set_partial_errors(["chip 3 flaky"])
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        stats = c.poll_once()
+        assert stats.ok  # partial errors degrade, not fail
+        assert (
+            store.current().value("tpu_exporter_poll_errors_total", ("device_partial",))
+            == 1
+        )
+
+
+class TestIciRates:
+    def test_counter_monotonic_and_rate(self, store):
+        script = FakeChipScript(ici_link_count=2, ici_bytes_per_step=500.0)
+        backend = FakeBackend(chips=1, script=script)
+        fake_now = [0.0]
+
+        def clock():
+            return fake_now[0]
+
+        c = make_collector(backend, FakeAttribution(), store, clock=clock)
+        c.poll_once()
+        labels = {**chip_labels(0), "link": "0"}
+        snap = store.current()
+        assert snap.value("tpu_ici_transferred_bytes_total", labels) == 500.0
+        # no rate on first poll (no dt)
+        assert snap.value("tpu_ici_link_bandwidth_bytes_per_second", labels) is None
+        fake_now[0] += 2.0
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_ici_transferred_bytes_total", labels) == 1000.0
+        assert snap.value("tpu_ici_link_bandwidth_bytes_per_second", labels) == 250.0
+
+    def test_rate_survives_pod_relabel(self, store):
+        # Chip moves pod-a -> pod-b between polls; counter state is keyed by
+        # full label set, so the new series starts fresh but stays monotonic.
+        script = FakeChipScript(ici_link_count=1, ici_bytes_per_step=100.0)
+        backend = FakeBackend(chips=1, script=script)
+        attr = FakeAttribution([simple_allocation("a", ["0"])])
+        fake_now = [0.0]
+        c = make_collector(backend, attr, store, clock=lambda: fake_now[0])
+        c.poll_once()
+        fake_now[0] += 1.0
+        attr.set_allocations([simple_allocation("b", ["0"])])
+        c.poll_once()
+        labels_b = {**chip_labels(0, "b", "default", "main"), "link": "0"}
+        assert store.current().value("tpu_ici_transferred_bytes_total", labels_b) == 200.0
+
+
+class TestSelfMetrics:
+    def test_poll_phases_and_counts(self, store, four_chip_backend, one_pod_attribution):
+        c = make_collector(four_chip_backend, one_pod_attribution, store)
+        c.poll_once()
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_exporter_polls_total") == 2
+        assert snap.value("tpu_exporter_series") == snap.series_count
+        for phase in ("device_read", "attribution", "join", "publish", "total"):
+            assert snap.value("tpu_exporter_poll_duration_seconds", (phase,)) is not None
+        info = snap.samples("tpu_exporter_info")
+        assert len(info) == 1
+        (values,) = info.keys()
+        assert values[1] == "fake" and values[2] == "fake"
+
+    def test_zero_devices_smoke(self, store):
+        # Baseline config 1: no devices at all, exporter healthy.
+        c = make_collector(FakeBackend(chips=0), FakeAttribution(), store)
+        stats = c.poll_once()
+        assert stats.ok
+        snap = store.current()
+        assert snap.value("tpu_exporter_up") == 1
+        assert snap.samples("tpu_hbm_used_bytes") == {}
+        # families still declared for a stable scrape surface
+        assert b"# TYPE tpu_hbm_used_bytes gauge" in snap.encode()

@@ -1,0 +1,176 @@
+"""Unit tests for the snapshot registry (SURVEY.md §4.1)."""
+
+import math
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpu_pod_exporter.metrics.registry import (
+    COUNTER,
+    CounterStore,
+    MetricSpec,
+    SnapshotBuilder,
+    SnapshotStore,
+    escape_label_value,
+    format_value,
+)
+
+G = MetricSpec(name="test_gauge", help="a gauge", label_names=("a", "b"))
+PLAIN = MetricSpec(name="test_plain", help="no labels")
+
+
+class TestMetricSpec:
+    def test_valid(self):
+        MetricSpec(name="tpu_hbm_used_bytes", help="h", label_names=("pod",))
+
+    @pytest.mark.parametrize("bad", ["", "1abc", "a-b", "a b", "abé"])
+    def test_invalid_name(self, bad):
+        with pytest.raises(ValueError):
+            MetricSpec(name=bad, help="h")
+
+    @pytest.mark.parametrize("bad", ["", "__reserved", "1a", "a-b"])
+    def test_invalid_label(self, bad):
+        with pytest.raises(ValueError):
+            MetricSpec(name="ok", help="h", label_names=(bad,))
+
+    def test_duplicate_labels(self):
+        with pytest.raises(ValueError):
+            MetricSpec(name="ok", help="h", label_names=("x", "x"))
+
+    def test_bad_type(self):
+        with pytest.raises(ValueError):
+            MetricSpec(name="ok", help="h", type="histogram")
+
+
+class TestFormatting:
+    def test_format_value(self):
+        assert format_value(0.0) == "0"
+        assert format_value(42.0) == "42"
+        assert format_value(1.5) == "1.5"
+        assert format_value(math.nan) == "NaN"
+        assert format_value(math.inf) == "+Inf"
+        assert format_value(-math.inf) == "-Inf"
+        assert format_value(2**60) == str(float(2**60)) or "e" in format_value(2**60)
+
+    def test_escape(self):
+        assert escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+class TestSnapshotBuilder:
+    def test_roundtrip_via_prometheus_parser(self):
+        b = SnapshotBuilder()
+        b.add(G, 1.25, {"a": "x", "b": 'quo"te'})
+        b.add(G, 2.0, ("y", "line\nbreak"))
+        b.add(PLAIN, 7)
+        snap = b.build()
+        text = snap.encode().decode()
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        assert fams["test_gauge"].type == "gauge"
+        samples = {tuple(sorted(s.labels.items())): s.value for s in fams["test_gauge"].samples}
+        assert samples[(("a", "x"), ("b", 'quo"te'))] == 1.25
+        assert samples[(("a", "y"), ("b", "line\nbreak"))] == 2.0
+        assert fams["test_plain"].samples[0].value == 7
+
+    def test_counter_type_rendered(self):
+        c = MetricSpec(name="test_total", help="h", type=COUNTER)
+        b = SnapshotBuilder()
+        b.add(c, 3)
+        text = b.build().encode().decode()
+        assert "# TYPE test_total counter" in text
+
+    def test_duplicate_label_set_last_wins(self):
+        b = SnapshotBuilder()
+        b.add(G, 1, ("x", "y"))
+        b.add(G, 2, ("x", "y"))
+        assert b.build().value("test_gauge", ("x", "y")) == 2
+
+    def test_label_arity_mismatch(self):
+        b = SnapshotBuilder()
+        with pytest.raises(ValueError):
+            b.add(G, 1, ("only-one",))
+
+    def test_unknown_label_rejected(self):
+        b = SnapshotBuilder()
+        with pytest.raises(ValueError):
+            b.add(G, 1, {"a": "x", "b": "y", "zzz": "?"})
+
+    def test_missing_label_rejected(self):
+        b = SnapshotBuilder()
+        with pytest.raises(ValueError):
+            b.add(G, 1, {"a": "x"})
+
+    def test_conflicting_redeclare(self):
+        b = SnapshotBuilder()
+        b.add(G, 1, ("x", "y"))
+        other = MetricSpec(name="test_gauge", help="different", label_names=("a", "b"))
+        with pytest.raises(ValueError):
+            b.declare(other)
+
+    def test_declared_family_appears_without_samples(self):
+        b = SnapshotBuilder()
+        b.declare(G)
+        text = b.build().encode().decode()
+        assert "# HELP test_gauge" in text
+        assert b.build().series_count == 0
+
+    def test_series_count(self):
+        b = SnapshotBuilder()
+        b.add(G, 1, ("x", "y"))
+        b.add(G, 1, ("x", "z"))
+        b.add(PLAIN, 1)
+        assert b.build().series_count == 3
+
+    def test_encode_cached(self):
+        b = SnapshotBuilder()
+        b.add(PLAIN, 1)
+        snap = b.build()
+        assert snap.encode() is snap.encode()
+
+
+class TestSnapshotStore:
+    def test_swap_and_current(self):
+        store = SnapshotStore()
+        assert store.current().series_count == 0
+        b = SnapshotBuilder()
+        b.add(PLAIN, 5)
+        snap = b.build()
+        store.swap(snap)
+        assert store.current() is snap
+        # swap pre-renders so the scrape path never encodes
+        assert snap._text is not None
+
+
+class TestCounterStore:
+    def test_inc(self):
+        c = CounterStore()
+        assert c.inc("n", ("a",)) == 1
+        assert c.inc("n", ("a",), 2.5) == 3.5
+        assert c.get("n", ("a",)) == 3.5
+        assert c.get("n", ("other",)) == 0
+
+    def test_negative_delta_ignored(self):
+        c = CounterStore()
+        c.inc("n", (), 5)
+        assert c.inc("n", (), -3) == 5
+
+    def test_observe_total_monotonic(self):
+        c = CounterStore()
+        assert c.observe_total("n", (), 100) == 100
+        assert c.observe_total("n", (), 150) == 150
+        # device counter reset: exported value holds, then resumes
+        assert c.observe_total("n", (), 10) == 150
+        assert c.observe_total("n", (), 60) == 200
+
+    def test_prune(self):
+        c = CounterStore()
+        c.inc("n", ("a",))
+        c.inc("n", ("b",))
+        assert c.prune({("n", ("a",))}) == 1
+        assert c.get("n", ("b",)) == 0
+        assert c.get("n", ("a",)) == 1
+
+    def test_items_for(self):
+        c = CounterStore()
+        c.inc("n", ("a",))
+        c.inc("m", ("b",))
+        assert c.items_for("n") == [(("a",), 1.0)]

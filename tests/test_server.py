@@ -1,0 +1,101 @@
+"""HTTP surface tests: real sockets, scrape semantics (SURVEY.md §4.3)."""
+
+import gzip
+import urllib.request
+
+import pytest
+
+from tpu_pod_exporter.metrics import MetricSpec, SnapshotBuilder, SnapshotStore
+from tpu_pod_exporter.server import MetricsServer
+
+
+@pytest.fixture
+def served_store():
+    store = SnapshotStore()
+    server = MetricsServer(store, host="127.0.0.1", port=0)
+    server.start()
+    yield store, f"http://127.0.0.1:{server.port}"
+    server.stop()
+
+
+def get(url, headers=None):
+    req = urllib.request.Request(url, headers=headers or {})
+    try:
+        resp = urllib.request.urlopen(req, timeout=5)
+        return resp.status, dict(resp.headers), resp.read()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read()
+
+
+def put_snapshot(store, value=1.0):
+    b = SnapshotBuilder()
+    b.add(MetricSpec(name="test_metric", help="t"), value)
+    store.swap(b.build())
+
+
+class TestEndpoints:
+    def test_metrics_empty_before_first_poll(self, served_store):
+        _, base = served_store
+        status, headers, body = get(base + "/metrics")
+        assert status == 200
+        assert headers["Content-Type"].startswith("text/plain")
+        assert body == b""
+
+    def test_metrics_after_swap(self, served_store):
+        store, base = served_store
+        put_snapshot(store, 42)
+        status, _, body = get(base + "/metrics")
+        assert status == 200
+        assert b"test_metric 42\n" in body
+
+    def test_scrape_serves_latest_snapshot(self, served_store):
+        store, base = served_store
+        put_snapshot(store, 1)
+        put_snapshot(store, 2)
+        _, _, body = get(base + "/metrics")
+        assert b"test_metric 2\n" in body
+
+    def test_healthz(self, served_store):
+        _, base = served_store
+        status, _, body = get(base + "/healthz")
+        assert status == 200 and body == b"ok\n"
+
+    def test_readyz_flips_on_first_snapshot(self, served_store):
+        store, base = served_store
+        status, _, _ = get(base + "/readyz")
+        assert status == 503
+        put_snapshot(store)
+        status, _, _ = get(base + "/readyz")
+        assert status == 200
+
+    def test_gzip_negotiation(self, served_store):
+        store, base = served_store
+        put_snapshot(store, 3)
+        status, headers, body = get(
+            base + "/metrics", headers={"Accept-Encoding": "gzip"}
+        )
+        assert status == 200
+        assert headers.get("Content-Encoding") == "gzip"
+        assert b"test_metric 3\n" in gzip.decompress(body)
+
+    def test_unknown_path_404(self, served_store):
+        _, base = served_store
+        status, _, _ = get(base + "/nope")
+        assert status == 404
+
+    def test_root_index(self, served_store):
+        _, base = served_store
+        status, _, body = get(base + "/")
+        assert status == 200 and b"tpu-pod-exporter" in body
+
+
+class TestPortConflict:
+    def test_second_bind_fails_loudly(self):
+        store = SnapshotStore()
+        first = MetricsServer(store, host="127.0.0.1", port=0)
+        first.start()
+        try:
+            with pytest.raises(OSError):
+                MetricsServer(store, host="127.0.0.1", port=first.port)
+        finally:
+            first.stop()

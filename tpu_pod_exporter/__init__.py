@@ -1,0 +1,26 @@
+"""tpu_pod_exporter — TPU-native per-pod device-metrics exporter for Kubernetes.
+
+A brand-new framework with the capability surface of
+``dorkamotorka/kubernetes-gpu-exporter`` (reference: ``main.go:1-158``), built
+TPU-first:
+
+- Device telemetry comes from TPU-native readers (libtpu runtime metrics
+  service / JAX device APIs / ``/dev/accel*`` discovery) instead of NVML
+  (reference ``main.go:44-54,116-138``).
+- Pod attribution comes from the kubelet podresources API
+  (``google.com/tpu`` device IDs) instead of a cluster-wide pod list plus
+  ``kubectl exec``/``ps`` PID joins (reference ``main.go:74-114``) — which
+  removes the reference's three attribution defects (index-vs-value join,
+  PID-namespace mismatch, container mistargeting).
+- Metrics are ``tpu_*`` Prometheus gauges with a full label schema
+  ``{pod, namespace, container, chip_id, ...topology}`` instead of the
+  reference's ``{pid, pod}`` pair (``main.go:21-36``).
+- Collection stays decoupled from scraping (reference ``main.go:67-72`` vs
+  ``main.go:74-157``): the poll loop pre-renders the exposition text and a
+  scrape serves cached bytes, making p99 scrape latency independent of
+  device-query latency.
+"""
+
+from tpu_pod_exporter.version import __version__
+
+__all__ = ["__version__"]

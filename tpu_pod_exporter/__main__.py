@@ -1,0 +1,6 @@
+import sys
+
+from tpu_pod_exporter.app import main
+
+if __name__ == "__main__":
+    sys.exit(main())

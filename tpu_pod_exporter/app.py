@@ -1,0 +1,172 @@
+"""Wiring: config → backend + attribution → collector loop → HTTP server.
+
+The analog of the reference's ``main()`` (``main.go:38-72``) but with
+dependency injection, backend auto-detection, SIGTERM drain, and no
+``log.Fatal`` anywhere on the steady-state path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import threading
+
+from tpu_pod_exporter.attribution import AttributionProvider
+from tpu_pod_exporter.attribution.fake import FakeAttribution
+from tpu_pod_exporter.backend import DeviceBackend
+from tpu_pod_exporter.backend.fake import FakeBackend
+from tpu_pod_exporter.collector import Collector, CollectorLoop
+from tpu_pod_exporter.config import ExporterConfig
+from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter.topology import detect_host_topology
+
+log = logging.getLogger("tpu_pod_exporter.app")
+
+
+def build_backend(cfg: ExporterConfig) -> DeviceBackend:
+    choice = cfg.backend
+    if choice == "auto":
+        # Production preference: libtpu metrics service (does not open the
+        # devices) > nothing. The jax backend is never auto-selected: it
+        # grabs the TPU runtime and would starve the workload.
+        from tpu_pod_exporter.backend.discovery import local_chip_count
+
+        if local_chip_count() > 0:
+            try:
+                return _build_named_backend("libtpu", cfg)
+            except Exception as e:  # noqa: BLE001
+                # Auto-detection must degrade, not crash-loop the DaemonSet:
+                # a monitoring agent that dies on init monitors nothing.
+                log.error("auto-selected libtpu backend unavailable (%s); "
+                          "serving 0-chip surface", e)
+                return FakeBackend(chips=0)
+        log.info("no local TPU devices found; using 0-chip fake backend")
+        return FakeBackend(chips=0)
+    # Explicit selection fails fast — a typo'd flag should be loud.
+    return _build_named_backend(choice, cfg)
+
+
+def _build_named_backend(choice: str, cfg: ExporterConfig) -> DeviceBackend:
+    if choice == "fake":
+        return FakeBackend(chips=cfg.fake_chips)
+    if choice == "jax":
+        from tpu_pod_exporter.backend.jaxdev import JaxDeviceBackend
+
+        return JaxDeviceBackend()
+    if choice == "libtpu":
+        from tpu_pod_exporter.backend.libtpu import LibtpuMetricsBackend
+
+        return LibtpuMetricsBackend(addr=cfg.libtpu_metrics_addr)
+    raise ValueError(f"unknown backend: {choice}")
+
+
+def build_attribution(cfg: ExporterConfig) -> AttributionProvider:
+    choice = cfg.attribution
+    if choice == "auto":
+        if os.path.exists(cfg.podresources_socket):
+            choice = "podresources"
+        elif os.path.exists(cfg.checkpoint_path):
+            choice = "checkpoint"
+        else:
+            log.info("no kubelet attribution source found; attribution disabled")
+            return FakeAttribution()
+        try:
+            return _build_named_attribution(choice, cfg)
+        except Exception as e:  # noqa: BLE001
+            log.error("auto-selected %s attribution unavailable (%s); "
+                      "attribution disabled", choice, e)
+            return FakeAttribution()
+    return _build_named_attribution(choice, cfg)
+
+
+def _build_named_attribution(choice: str, cfg: ExporterConfig) -> AttributionProvider:
+    if choice in ("fake", "none"):
+        return FakeAttribution()
+    if choice == "podresources":
+        from tpu_pod_exporter.attribution.podresources import PodResourcesAttribution
+
+        return PodResourcesAttribution(socket_path=cfg.podresources_socket)
+    if choice == "checkpoint":
+        from tpu_pod_exporter.attribution.checkpoint import CheckpointAttribution
+
+        return CheckpointAttribution(path=cfg.checkpoint_path)
+    raise ValueError(f"unknown attribution: {choice}")
+
+
+class ExporterApp:
+    """Everything needed to run (and cleanly stop) one exporter instance.
+
+    Also the harness object for multi-instance tests: N apps with distinct
+    fakes model N hosts of a v5p slice (SURVEY.md §4.4).
+    """
+
+    def __init__(
+        self,
+        cfg: ExporterConfig,
+        backend: DeviceBackend | None = None,
+        attribution: AttributionProvider | None = None,
+    ) -> None:
+        self.cfg = cfg
+        self.store = SnapshotStore()
+        self.backend = backend if backend is not None else build_backend(cfg)
+        self.attribution = (
+            attribution if attribution is not None else build_attribution(cfg)
+        )
+        topo = detect_host_topology(
+            accelerator=cfg.accelerator,
+            slice_name=cfg.slice_name,
+            host=cfg.node_name,
+            worker_id=cfg.worker_id,
+        )
+        self.collector = Collector(
+            backend=self.backend,
+            attribution=self.attribution,
+            store=self.store,
+            topology=topo,
+            resource_name=cfg.resource_name,
+            attribution_max_stale_s=cfg.attribution_max_stale_s,
+        )
+        self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
+        self.server = MetricsServer(self.store, host=cfg.host, port=cfg.port)
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> None:
+        # First poll synchronously so /readyz flips as soon as we listen.
+        self.collector.poll_once()
+        self.loop.start()
+        self.server.start()
+        log.info("serving on :%d every %.3fs", self.port, self.cfg.interval_s)
+
+    def stop(self) -> None:
+        self.loop.stop()
+        self.server.stop()
+        self.collector.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    cfg = ExporterConfig.from_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+    app = ExporterApp(cfg)
+    stop = threading.Event()
+
+    def _on_signal(signum, frame) -> None:  # noqa: ARG001
+        log.info("signal %d: draining", signum)
+        stop.set()
+
+    # Real SIGTERM drain for DaemonSet rolling updates (reference has none —
+    # its only exits are log.Fatalf/panic, SURVEY.md §3.4).
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    app.start()
+    stop.wait()
+    app.stop()
+    return 0

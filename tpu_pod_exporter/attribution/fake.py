@@ -1,0 +1,64 @@
+"""Scripted attribution provider — the podresources fake (SURVEY.md §4.2).
+
+Supports instantaneous reassignment (``set_allocations``) for churn stress
+(baseline config 5) and fault injection (``fail_next``) for §4.5.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Iterable, Sequence
+
+from tpu_pod_exporter.attribution import (
+    AttributionError,
+    AttributionProvider,
+    AttributionSnapshot,
+    DeviceAllocation,
+    TPU_RESOURCE_NAME,
+)
+
+
+class FakeAttribution(AttributionProvider):
+    name = "fake"
+
+    def __init__(self, allocations: Sequence[DeviceAllocation] = ()) -> None:
+        self._lock = threading.Lock()
+        self._snapshot = AttributionSnapshot(tuple(allocations))
+        self._fail_next = 0
+        self.snapshot_calls = 0
+        self.closed = False
+
+    def set_allocations(self, allocations: Iterable[DeviceAllocation]) -> None:
+        snap = AttributionSnapshot(tuple(allocations))
+        with self._lock:
+            self._snapshot = snap
+
+    def fail_next(self, n: int = 1) -> None:
+        with self._lock:
+            self._fail_next += n
+
+    def snapshot(self) -> AttributionSnapshot:
+        with self._lock:
+            self.snapshot_calls += 1
+            if self._fail_next > 0:
+                self._fail_next -= 1
+                raise AttributionError("fake attribution: injected failure")
+            return self._snapshot
+
+    def close(self) -> None:
+        self.closed = True
+
+
+def simple_allocation(
+    pod: str,
+    device_ids: Sequence[str],
+    namespace: str = "default",
+    container: str = "main",
+) -> DeviceAllocation:
+    return DeviceAllocation(
+        pod=pod,
+        namespace=namespace,
+        container=container,
+        device_ids=tuple(device_ids),
+        resource_name=TPU_RESOURCE_NAME,
+    )

@@ -1,0 +1,108 @@
+"""Device telemetry backends — the TPU-native analog of the NVML layer.
+
+The reference binds its telemetry source directly into ``main()`` via cgo
+(``nvml.Init``/``DeviceGetCount``/``GetMemoryInfo``/
+``GetComputeRunningProcesses``, ``main.go:44-54,116-138``) with no seam, so
+nothing is testable without an NVIDIA driver. Here the backend is an
+interface with several implementations:
+
+- :class:`~tpu_pod_exporter.backend.fake.FakeBackend` — scripted chip
+  metrics for tests, the 0-device smoke config, and benchmarks.
+- :class:`~tpu_pod_exporter.backend.jaxdev.JaxDeviceBackend` — live HBM
+  telemetry via JAX device ``memory_stats()``. Holds the TPU runtime, so it
+  is for dev/bench colocated-with-workload setups, not the DaemonSet.
+- :class:`~tpu_pod_exporter.backend.libtpu.LibtpuMetricsBackend` — the
+  production path: reads the libtpu runtime metrics gRPC service (the same
+  endpoint ``tpu-info`` uses) without ever opening the TPU devices.
+
+A backend returns one :class:`HostSample` per call: every local chip's HBM
+used/total, TensorCore duty cycle, and per-ICI-link cumulative traffic
+counters. Errors raise :class:`BackendError`; the collector contains them
+per-iteration instead of dying (inverts the reference's ``log.Fatalf`` in
+the hot loop, ``main.go:119-137``).
+"""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+
+
+class BackendError(RuntimeError):
+    """A device-telemetry read failed; the poll should degrade, not die."""
+
+
+@dataclass(frozen=True)
+class ChipInfo:
+    """Static identity of one local TPU chip.
+
+    ``chip_id`` is the stable per-host index (the analog of the NVML device
+    index, ``main.go:123-124``). ``device_ids`` are the kubelet device-plugin
+    IDs this chip appears as in podresources (``google.com/tpu`` resource) —
+    the join key for attribution.
+    """
+
+    chip_id: int
+    device_path: str = ""
+    device_ids: tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if not self.device_ids:
+            object.__setattr__(self, "device_ids", (str(self.chip_id),))
+
+
+@dataclass(frozen=True)
+class IciLinkSample:
+    """One inter-chip-interconnect link's cumulative traffic counter."""
+
+    link: str                      # stable link id, e.g. "0".."5" (3D torus: ±x,±y,±z)
+    transferred_bytes_total: float # monotonic since runtime start
+
+
+@dataclass(frozen=True)
+class ChipSample:
+    """One chip's telemetry at one instant."""
+
+    info: ChipInfo
+    hbm_used_bytes: float
+    hbm_total_bytes: float
+    tensorcore_duty_cycle_percent: float | None = None
+    ici_links: tuple[IciLinkSample, ...] = ()
+
+
+@dataclass(frozen=True)
+class HostSample:
+    """All local chips' telemetry from one backend read."""
+
+    chips: tuple[ChipSample, ...] = ()
+    # Non-fatal per-chip read problems the collector should count but not die on.
+    partial_errors: tuple[str, ...] = ()
+
+
+class DeviceBackend(abc.ABC):
+    """The seam the reference lacks (SURVEY.md §4): all attribution and
+    publishing logic must be provable against fakes, with the real backend a
+    drop-in."""
+
+    name: str = "abstract"
+
+    @abc.abstractmethod
+    def sample(self) -> HostSample:
+        """Read all local chips. Raises BackendError on total failure."""
+
+    def close(self) -> None:  # analog of nvml.Shutdown (main.go:49-54)
+        return None
+
+
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript  # noqa: E402
+
+__all__ = [
+    "BackendError",
+    "ChipInfo",
+    "ChipSample",
+    "DeviceBackend",
+    "FakeBackend",
+    "FakeChipScript",
+    "HostSample",
+    "IciLinkSample",
+]

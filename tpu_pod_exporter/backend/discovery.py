@@ -1,0 +1,95 @@
+"""Local TPU device discovery — the analog of ``nvml.DeviceGetCount``
+(``main.go:116-120``), without opening any device.
+
+TPU VMs expose chips as ``/dev/accel{N}`` (v2-v5) or via vfio
+(``/dev/vfio/*``, v6e+); sysfs mirrors them under ``/sys/class/accel``.
+Discovery is a directory scan — no driver init, no runtime lock, safe to run
+next to a training job.
+
+A native C++ scanner (``native/tpumon.cc``) provides the same interface for
+the hot path; this module is the pure-Python implementation and the ctypes
+loader, falling back transparently when the shared library is absent.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import os
+import re
+from pathlib import Path
+
+from tpu_pod_exporter.backend import ChipInfo
+
+_ACCEL_GLOBS = ("/dev/accel*", "/dev/vfio/[0-9]*")
+_SYS_ACCEL = "/sys/class/accel"
+
+_native = None
+_native_tried = False
+
+
+def _load_native() -> ctypes.CDLL | None:
+    global _native, _native_tried
+    if _native_tried:
+        return _native
+    _native_tried = True
+    here = Path(__file__).resolve().parent.parent.parent
+    for cand in (
+        here / "native" / "libtpumon.so",
+        Path("/usr/local/lib/libtpumon.so"),
+    ):
+        if cand.exists():
+            try:
+                lib = ctypes.CDLL(str(cand))
+                lib.tpumon_count_devices.restype = ctypes.c_int
+                lib.tpumon_count_devices.argtypes = [ctypes.c_char_p]
+                _native = lib
+                break
+            except (OSError, AttributeError):
+                # unloadable, or loadable but missing the symbol (stale .so):
+                # fall back to the pure-Python scan either way
+                continue
+    return _native
+
+
+def list_device_paths(root: str = "/") -> list[str]:
+    """Paths of local TPU device nodes, sorted by chip index."""
+    out: list[str] = []
+    for pattern in _ACCEL_GLOBS:
+        out.extend(glob.glob(os.path.join(root, pattern.lstrip("/"))))
+    sys_accel = os.path.join(root, _SYS_ACCEL.lstrip("/"))
+    if not out and os.path.isdir(sys_accel):
+        out = [
+            os.path.join("/dev", name)
+            for name in sorted(os.listdir(sys_accel))
+            if name.startswith("accel")
+        ]
+
+    def key(p: str) -> tuple[int, str]:
+        m = re.search(r"(\d+)$", p)
+        return (int(m.group(1)) if m else 1 << 30, p)
+
+    return sorted(set(out), key=key)
+
+
+def local_chip_count(root: str = "/") -> int:
+    lib = _load_native()
+    if lib is not None and root == "/":
+        n = lib.tpumon_count_devices(b"/")
+        if n >= 0:
+            return n
+    return len(list_device_paths(root))
+
+
+def discover_chips(root: str = "/") -> list[ChipInfo]:
+    """ChipInfo for each local device node. Device-plugin IDs default to the
+    chip index as a string — the GKE TPU device plugin enumerates devices
+    ``0..N-1`` per node, which is also what podresources reports.  [design]
+    """
+    paths = list_device_paths(root)
+    chips: list[ChipInfo] = []
+    for i, path in enumerate(paths):
+        m = re.search(r"(\d+)$", path)
+        idx = int(m.group(1)) if m else i
+        chips.append(ChipInfo(chip_id=idx, device_path=path, device_ids=(str(idx),)))
+    return chips

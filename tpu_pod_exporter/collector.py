@@ -1,0 +1,340 @@
+"""The poll loop: read devices, read attribution, join, publish.
+
+Redesign of the reference's collection loop (``main.go:74-157``) with these
+deliberate inversions (SURVEY.md §7):
+
+- **Error containment**: every phase catches its errors, increments an error
+  counter, and degrades — the reference instead ``log.Fatalf``s on any NVML
+  error mid-loop (``main.go:119,126,131,137``) and ``panic``s on apiserver
+  blips (``main.go:79``).
+- **Join by device ID**: chip → allocation via the podresources device-ID
+  map, O(chips) dict lookups — the reference does an O(devices × procs ×
+  pods × pids) nested scan over the wrong join key (``main.go:141-154``).
+- **Structural stale-series GC**: each poll builds a complete snapshot and
+  swaps it; dead pods' series vanish on the next poll — the reference never
+  deletes a series.
+- **Bounded attribution staleness**: if the attribution source fails, the
+  last good snapshot is reused for up to ``attribution_max_stale_s`` so chip
+  metrics keep flowing with slightly stale ownership, then attribution
+  labels drop to "" rather than lie indefinitely.
+- **Drift-free scheduling**: ticks are scheduled at ``start + n·interval``
+  (the reference sleeps a flat 30 s *after* each iteration, ``main.go:156``,
+  so its period is interval + iteration time).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+
+from tpu_pod_exporter.attribution import (
+    AttributionError,
+    AttributionProvider,
+    AttributionSnapshot,
+    TPU_RESOURCE_NAME,
+)
+from tpu_pod_exporter.backend import BackendError, DeviceBackend, HostSample
+from tpu_pod_exporter.metrics import CounterStore, Snapshot, SnapshotBuilder, SnapshotStore
+from tpu_pod_exporter.metrics import schema
+from tpu_pod_exporter.topology import HostTopology
+from tpu_pod_exporter.version import __version__
+
+log = logging.getLogger("tpu_pod_exporter.collector")
+
+
+@dataclass
+class PollStats:
+    """Per-phase timing + outcome of one poll (SURVEY.md §5 tracing)."""
+
+    device_read_s: float = 0.0
+    attribution_s: float = 0.0
+    join_s: float = 0.0
+    publish_s: float = 0.0
+    total_s: float = 0.0
+    ok: bool = True
+    errors: tuple[str, ...] = ()
+
+
+class Collector:
+    def __init__(
+        self,
+        backend: DeviceBackend,
+        attribution: AttributionProvider,
+        store: SnapshotStore,
+        topology: HostTopology | None = None,
+        resource_name: str = TPU_RESOURCE_NAME,
+        attribution_max_stale_s: float = 30.0,
+        clock=time.monotonic,
+        wallclock=time.time,
+    ) -> None:
+        self._backend = backend
+        self._attribution = attribution
+        self._store = store
+        self._topology = topology or HostTopology()
+        self._resource_name = resource_name
+        self._attribution_max_stale_s = attribution_max_stale_s
+        self._clock = clock
+        self._wallclock = wallclock
+
+        self._counters = CounterStore()
+        self._last_attr: AttributionSnapshot | None = None
+        self._last_attr_at: float = 0.0
+        # previous folded ICI totals + read time, for bandwidth rates
+        self._prev_ici_totals: dict[tuple[str, str], float] = {}
+        self._prev_ici_at: float | None = None
+        self.last_stats = PollStats()
+
+    # ------------------------------------------------------------------ poll
+
+    def poll_once(self) -> PollStats:
+        t0 = self._clock()
+        errors: list[str] = []
+
+        # Phase 1: device read (analog of main.go:116-138, error-contained).
+        td0 = self._clock()
+        host_sample: HostSample | None = None
+        try:
+            host_sample = self._backend.sample()
+            for msg in host_sample.partial_errors:
+                errors.append("device_partial")
+                log.warning("device partial error: %s", msg)
+        except BackendError as e:
+            errors.append("device_read")
+            log.warning("device read failed: %s", e)
+        except Exception as e:  # noqa: BLE001 — never die in the loop
+            errors.append("device_read")
+            log.error("device read failed unexpectedly: %s", e, exc_info=True)
+        td1 = self._clock()
+
+        # Phase 2: attribution (replaces main.go:74-114).
+        attr = self._read_attribution(errors)
+        ta1 = self._clock()
+
+        # Phase 3: join (replaces main.go:141-154).
+        device_owner = attr.by_device_id(self._resource_name) if attr else {}
+        tj1 = self._clock()
+
+        # Phase 4: publish.
+        stats = PollStats(
+            device_read_s=td1 - td0,
+            attribution_s=ta1 - td1,
+            join_s=tj1 - ta1,
+            ok="device_read" not in errors,
+            errors=tuple(errors),
+        )
+        self._publish(host_sample, device_owner, stats, now_mono=tj1)
+        tp1 = self._clock()
+        stats.publish_s = tp1 - tj1
+        stats.total_s = tp1 - t0
+        self.last_stats = stats
+        return stats
+
+    def _read_attribution(self, errors: list[str]) -> AttributionSnapshot | None:
+        now = self._clock()
+        try:
+            snap = self._attribution.snapshot()
+            self._last_attr = snap
+            self._last_attr_at = now
+            return snap
+        except AttributionError as e:
+            errors.append("attribution")
+            log.warning("attribution read failed: %s", e)
+        except Exception as e:  # noqa: BLE001
+            errors.append("attribution")
+            log.error("attribution failed unexpectedly: %s", e, exc_info=True)
+        # Bounded-staleness reuse of the last good snapshot.
+        if (
+            self._last_attr is not None
+            and now - self._last_attr_at <= self._attribution_max_stale_s
+        ):
+            return self._last_attr
+        return None
+
+    # --------------------------------------------------------------- publish
+
+    def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float) -> None:
+        b = SnapshotBuilder()
+        topo = self._topology.labels()
+
+        # Declare the full schema up front so families are present (and typed)
+        # even when sample-less — scrapers see a stable surface from poll #1.
+        for spec in schema.ALL_SPECS:
+            b.declare(spec)
+
+        live_counter_keys: set[tuple[str, tuple[str, ...]]] = set()
+        pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used]
+        ici_now: dict[tuple[str, str], float] = {}
+
+        if host_sample is not None:
+            dt = None
+            if self._prev_ici_at is not None:
+                dt = max(now_mono - self._prev_ici_at, 1e-9)
+            for chip in host_sample.chips:
+                owner = None
+                for did in chip.info.device_ids:
+                    owner = device_owner.get(did)
+                    if owner is not None:
+                        break
+                chip_labels = {
+                    "chip_id": str(chip.info.chip_id),
+                    "device_path": chip.info.device_path,
+                    **topo,
+                    "pod": owner.pod if owner else "",
+                    "namespace": owner.namespace if owner else "",
+                    "container": owner.container if owner else "",
+                }
+                b.add(schema.TPU_HBM_USED_BYTES, chip.hbm_used_bytes, chip_labels)
+                b.add(schema.TPU_HBM_TOTAL_BYTES, chip.hbm_total_bytes, chip_labels)
+                b.add(
+                    schema.TPU_HBM_USED_PERCENT,
+                    schema.hbm_used_percent(chip.hbm_used_bytes, chip.hbm_total_bytes),
+                    chip_labels,
+                )
+                if chip.tensorcore_duty_cycle_percent is not None:
+                    b.add(
+                        schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
+                        chip.tensorcore_duty_cycle_percent,
+                        chip_labels,
+                    )
+
+                for link in chip.ici_links:
+                    ici_labels = {**chip_labels, "link": link.link}
+                    lv = tuple(
+                        ici_labels[ln]
+                        for ln in schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.label_names
+                    )
+                    total = self._counters.observe_total(
+                        schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name,
+                        lv,
+                        link.transferred_bytes_total,
+                    )
+                    live_counter_keys.add(
+                        (schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name, lv)
+                    )
+                    b.add(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL, total, ici_labels)
+
+                    rate_key = (str(chip.info.chip_id), link.link)
+                    ici_now[rate_key] = total
+                    prev = self._prev_ici_totals.get(rate_key)
+                    if dt is not None and prev is not None:
+                        b.add(
+                            schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
+                            max(total - prev, 0.0) / dt,
+                            ici_labels,
+                        )
+
+                if owner is not None:
+                    rk = (owner.pod, owner.namespace) + tuple(
+                        topo[k] for k in ("accelerator", "slice_name", "host", "worker_id")
+                    )
+                    agg = pod_rollup.setdefault(rk, [0.0, 0.0])
+                    agg[0] += 1.0
+                    agg[1] += chip.hbm_used_bytes
+
+            self._prev_ici_totals = ici_now
+            self._prev_ici_at = now_mono
+
+        for rk, (nchips, hbm) in pod_rollup.items():
+            labels = dict(zip(schema.POD_LABELS, rk))
+            b.add(schema.TPU_POD_CHIP_COUNT, nchips, labels)
+            b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, labels)
+
+        # Self-metrics (SURVEY.md §5).
+        b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)
+        # This poll's read/join timings; publish/total are not known until
+        # after the swap, so the previous poll's values stand in for them.
+        for phase, dur in (
+            ("device_read", stats.device_read_s),
+            ("attribution", stats.attribution_s),
+            ("join", stats.join_s),
+            ("publish", self.last_stats.publish_s),
+            ("total", self.last_stats.total_s),
+        ):
+            b.add(schema.TPU_EXPORTER_POLL_DURATION_SECONDS, dur, {"phase": phase})
+        for source in stats.errors:
+            self._counters.inc(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name, (source,))
+        for lv, v in self._counters.items_for(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name):
+            b.add(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL, v, lv)
+        polls = self._counters.inc(schema.TPU_EXPORTER_POLLS_TOTAL.name, ())
+        b.add(schema.TPU_EXPORTER_POLLS_TOTAL, polls)
+        b.add(
+            schema.TPU_EXPORTER_INFO,
+            1.0,
+            {
+                "version": __version__,
+                "backend": getattr(self._backend, "name", "?"),
+                "attribution": getattr(self._attribution, "name", "?"),
+            },
+        )
+        b.add(schema.TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS, self._wallclock())
+
+        # Prune counter state for vanished chips/links (keep self-metric and
+        # error counters — they are node-lifetime).
+        keep = set(live_counter_keys)
+        for name in (
+            schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name,
+            schema.TPU_EXPORTER_POLLS_TOTAL.name,
+        ):
+            for lv, _ in self._counters.items_for(name):
+                keep.add((name, lv))
+        self._counters.prune(keep)
+
+        # +1 accounts for the series-count series itself.
+        b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))
+        self._store.swap(b.build(timestamp=self._wallclock()))
+
+    def close(self) -> None:
+        self._backend.close()
+        self._attribution.close()
+
+
+class CollectorLoop:
+    """Background thread driving Collector.poll_once on a fixed schedule.
+
+    Ticks at ``start + n·interval`` (no drift), skips ticks it cannot meet
+    (logs + counts overruns rather than queueing), and exits promptly on
+    ``stop()`` — real SIGTERM drain for DaemonSet rolling updates, which the
+    reference lacks entirely (SURVEY.md §3.4).
+    """
+
+    def __init__(self, collector: Collector, interval_s: float = 1.0) -> None:
+        if interval_s <= 0:
+            raise ValueError("interval must be positive")
+        self._collector = collector
+        self.interval_s = interval_s
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None
+        self.overruns = 0
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("already started")
+        self._thread = threading.Thread(target=self._run, name="tpu-exporter-poll", daemon=True)
+        self._thread.start()
+
+    def _run(self) -> None:
+        start = time.monotonic()
+        n = 0
+        while not self._stop.is_set():
+            try:
+                self._collector.poll_once()
+            except Exception:  # noqa: BLE001 — the loop must survive anything
+                log.exception("poll iteration failed")
+            n += 1
+            next_tick = start + n * self.interval_s
+            now = time.monotonic()
+            if next_tick <= now:
+                missed = int((now - start) / self.interval_s) - n + 1
+                if missed > 0:
+                    self.overruns += missed
+                    n += missed
+                    next_tick = start + n * self.interval_s
+            self._stop.wait(max(next_tick - time.monotonic(), 0.0))
+
+    def stop(self, timeout: float = 5.0) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=timeout)
+            self._thread = None

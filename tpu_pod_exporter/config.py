@@ -1,0 +1,62 @@
+"""Configuration — flags + environment, with sane defaults.
+
+The reference hardcodes everything: port ``:8000`` (``main.go:71``), 30 s
+interval (``main.go:156``), all-namespaces scope (``main.go:77``), metric
+names (``main.go:24,31``). Here every knob is a flag with an ``TPE_*``
+environment fallback, and backend/attribution sources are selectable at
+startup — the fake backends must be reachable from the command line for the
+0-device smoke config (SURVEY.md §5 "Config / flag system").
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+from dataclasses import dataclass, field, fields
+
+
+@dataclass
+class ExporterConfig:
+    port: int = 8000
+    host: str = "0.0.0.0"
+    interval_s: float = 1.0
+    backend: str = "auto"          # auto | fake | jax | libtpu
+    attribution: str = "auto"      # auto | fake | podresources | checkpoint | none
+    resource_name: str = "google.com/tpu"
+    fake_chips: int = 0            # chip count when backend=fake
+    podresources_socket: str = "/var/lib/kubelet/pod-resources/kubelet.sock"
+    checkpoint_path: str = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
+    libtpu_metrics_addr: str = "localhost:8431"
+    attribution_max_stale_s: float = 30.0
+    accelerator: str = ""          # override TPU_ACCELERATOR_TYPE
+    slice_name: str = ""
+    node_name: str = ""
+    worker_id: str = ""
+    log_level: str = "info"
+
+    @staticmethod
+    def _env_default(name: str, fallback):
+        raw = os.environ.get(f"TPE_{name.upper()}")
+        if raw is None:
+            return fallback
+        if isinstance(fallback, bool):
+            return raw.lower() in ("1", "true", "yes")
+        if isinstance(fallback, int):
+            return int(raw)
+        if isinstance(fallback, float):
+            return float(raw)
+        return raw
+
+    @classmethod
+    def from_args(cls, argv: list[str] | None = None) -> "ExporterConfig":
+        defaults = cls()
+        p = argparse.ArgumentParser(
+            prog="tpu-pod-exporter",
+            description="TPU-native per-pod device-metrics exporter for Kubernetes.",
+        )
+        for f in fields(cls):
+            flag = "--" + f.name.replace("_", "-")
+            default = cls._env_default(f.name, getattr(defaults, f.name))
+            p.add_argument(flag, type=type(getattr(defaults, f.name)), default=default)
+        ns = p.parse_args(argv)
+        return cls(**{f.name: getattr(ns, f.name) for f in fields(cls)})

@@ -1,0 +1,19 @@
+from tpu_pod_exporter.metrics.registry import (
+    COUNTER,
+    GAUGE,
+    CounterStore,
+    MetricSpec,
+    Snapshot,
+    SnapshotBuilder,
+    SnapshotStore,
+)
+
+__all__ = [
+    "COUNTER",
+    "GAUGE",
+    "CounterStore",
+    "MetricSpec",
+    "Snapshot",
+    "SnapshotBuilder",
+    "SnapshotStore",
+]

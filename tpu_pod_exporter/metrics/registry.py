@@ -1,0 +1,311 @@
+"""Snapshot-based metrics model with Prometheus text exposition.
+
+Design (deliberate delta from the reference, ``main.go:21-42``):
+
+The reference mutates long-lived ``GaugeVec`` cells in place and never deletes
+them, so a series for a dead pod persists at its last value forever
+(``main.go:147-150``; no ``Delete``/``Reset`` anywhere). Here the collector
+builds a complete :class:`Snapshot` every poll and atomically swaps it in.
+Stale-series garbage collection is therefore *structural*: a series that is
+not re-emitted simply does not exist in the next snapshot. This is the
+series-lifecycle semantics the pod-churn config requires.
+
+The snapshot also pre-renders the Prometheus text format once, at poll time.
+A scrape serves the cached bytes — O(1), no label formatting, no float
+rendering, no lock contention with the poll loop beyond one reference swap.
+This preserves (and sharpens) the reference's one good architectural
+property: collection decoupled from scraping (``main.go:67-72`` vs the poll
+loop at ``main.go:74-157``).
+
+Counters are supported for monotonic device counters (e.g. ICI transferred
+bytes); their *state* lives with the owner (the collector), the snapshot just
+renders current values.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Iterable, Mapping, Sequence
+
+GAUGE = "gauge"
+COUNTER = "counter"
+
+_VALID_TYPES = (GAUGE, COUNTER)
+
+
+@dataclass(frozen=True)
+class MetricSpec:
+    """Static definition of one metric family (name, help, type, label names).
+
+    Analog of the reference's ``prometheus.NewGaugeVec`` options
+    (``main.go:22-35``), except label names are part of a frozen schema and
+    validated once.
+    """
+
+    name: str
+    help: str
+    type: str = GAUGE
+    label_names: tuple[str, ...] = ()
+
+    def __post_init__(self) -> None:
+        if self.type not in _VALID_TYPES:
+            raise ValueError(f"metric type must be one of {_VALID_TYPES}: {self.type}")
+        if not _valid_metric_name(self.name):
+            raise ValueError(f"invalid metric name: {self.name!r}")
+        for ln in self.label_names:
+            if not _valid_label_name(ln):
+                raise ValueError(f"invalid label name: {ln!r}")
+        if len(set(self.label_names)) != len(self.label_names):
+            raise ValueError(f"duplicate label names in {self.name}")
+
+
+def _valid_metric_name(name: str) -> bool:
+    if not name:
+        return False
+    head = name[0]
+    if not (head.isascii() and (head.isalpha() or head in "_:")):
+        return False
+    return all(c.isascii() and (c.isalnum() or c in "_:") for c in name[1:])
+
+
+def _valid_label_name(name: str) -> bool:
+    if not name or name.startswith("__"):
+        return False
+    head = name[0]
+    if not (head.isascii() and (head.isalpha() or head == "_")):
+        return False
+    return all(c.isascii() and (c.isalnum() or c == "_") for c in name[1:])
+
+
+def escape_label_value(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def escape_help(value: str) -> str:
+    return value.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def format_value(value: float) -> str:
+    """Render a sample value the way Prometheus expects."""
+    if math.isnan(value):
+        return "NaN"
+    if math.isinf(value):
+        return "+Inf" if value > 0 else "-Inf"
+    if value == int(value) and abs(value) < 2**53:
+        return str(int(value))
+    return repr(float(value))
+
+
+@dataclass
+class _Family:
+    spec: MetricSpec
+    # label-values-tuple -> value; insertion order is emission order
+    samples: dict[tuple[str, ...], float] = field(default_factory=dict)
+
+
+class SnapshotBuilder:
+    """Accumulates one poll's worth of samples, then freezes into a Snapshot.
+
+    ``add`` replaces on duplicate label sets (last write wins within a poll,
+    which the collector avoids by construction but must not crash on —
+    contrast with the reference silently collapsing multi-device series,
+    ``main.go:141-155``).
+    """
+
+    def __init__(self) -> None:
+        self._families: dict[str, _Family] = {}
+        self._order: list[str] = []
+
+    def declare(self, spec: MetricSpec) -> None:
+        """Register a family so it appears (possibly sample-less) in output."""
+        existing = self._families.get(spec.name)
+        if existing is not None:
+            if existing.spec != spec:
+                raise ValueError(f"conflicting redeclaration of {spec.name}")
+            return
+        self._families[spec.name] = _Family(spec)
+        self._order.append(spec.name)
+
+    def add(
+        self,
+        spec: MetricSpec,
+        value: float,
+        labels: Mapping[str, str] | Sequence[str] = (),
+    ) -> None:
+        self.declare(spec)
+        fam = self._families[spec.name]
+        if isinstance(labels, Mapping):
+            try:
+                values = tuple(str(labels[ln]) for ln in spec.label_names)
+            except KeyError as e:
+                raise ValueError(f"missing label {e} for {spec.name}") from e
+            extra = set(labels) - set(spec.label_names)
+            if extra:
+                raise ValueError(f"unknown labels {sorted(extra)} for {spec.name}")
+        else:
+            values = tuple(str(v) for v in labels)
+            if len(values) != len(spec.label_names):
+                raise ValueError(
+                    f"{spec.name}: got {len(values)} label values, "
+                    f"want {len(spec.label_names)}"
+                )
+        fam.samples[values] = float(value)
+
+    @property
+    def series_count(self) -> int:
+        return sum(len(f.samples) for f in self._families.values())
+
+    def build(self, timestamp: float | None = None) -> "Snapshot":
+        return Snapshot(
+            families={
+                name: _Family(f.spec, dict(f.samples))
+                for name, f in ((n, self._families[n]) for n in self._order)
+            },
+            timestamp=time.time() if timestamp is None else timestamp,
+        )
+
+
+class Snapshot:
+    """An immutable, pre-rendered view of all series at one poll instant."""
+
+    def __init__(self, families: dict[str, _Family], timestamp: float) -> None:
+        self._families = families
+        self.timestamp = timestamp
+        self._text: bytes | None = None
+        self._gzipped: bytes | None = None
+
+    @property
+    def series_count(self) -> int:
+        return sum(len(f.samples) for f in self._families.values())
+
+    def families(self) -> Iterable[MetricSpec]:
+        return (f.spec for f in self._families.values())
+
+    def value(
+        self, name: str, labels: Mapping[str, str] | Sequence[str] = ()
+    ) -> float | None:
+        """Test/introspection helper: value of one series, or None."""
+        fam = self._families.get(name)
+        if fam is None:
+            return None
+        if isinstance(labels, Mapping):
+            key = tuple(str(labels.get(ln, "")) for ln in fam.spec.label_names)
+        else:
+            key = tuple(str(v) for v in labels)
+        return fam.samples.get(key)
+
+    def samples(self, name: str) -> dict[tuple[str, ...], float]:
+        fam = self._families.get(name)
+        return dict(fam.samples) if fam is not None else {}
+
+    def encode(self) -> bytes:
+        """Prometheus text exposition format (rendered once, then cached)."""
+        if self._text is not None:
+            return self._text
+        out: list[str] = []
+        for fam in self._families.values():
+            spec = fam.spec
+            out.append(f"# HELP {spec.name} {escape_help(spec.help)}\n")
+            out.append(f"# TYPE {spec.name} {spec.type}\n")
+            if not spec.label_names:
+                for _, value in fam.samples.items():
+                    out.append(f"{spec.name} {format_value(value)}\n")
+                continue
+            for values, value in fam.samples.items():
+                pairs = ",".join(
+                    f'{ln}="{escape_label_value(lv)}"'
+                    for ln, lv in zip(spec.label_names, values)
+                )
+                out.append(f"{spec.name}{{{pairs}}} {format_value(value)}\n")
+        self._text = "".join(out).encode("utf-8")
+        return self._text
+
+    def encode_gzip(self) -> bytes:
+        """Gzipped exposition, compressed once per poll, not per scrape —
+        Prometheus sends Accept-Encoding: gzip by default, so this IS the
+        production scrape body."""
+        if self._gzipped is None:
+            import gzip
+
+            self._gzipped = gzip.compress(self.encode(), compresslevel=1)
+        return self._gzipped
+
+
+EMPTY_SNAPSHOT = Snapshot({}, timestamp=0.0)
+
+
+class SnapshotStore:
+    """The single cross-thread handoff point between poll loop and scrapes.
+
+    The reference relies on prometheus GaugeVec's internal locking for its
+    loop-writes/scrape-reads overlap (``main.go:68-72`` vs ``main.go:147-150``).
+    Here *all* shared state is one reference guarded by a lock; scrapes never
+    observe a half-written poll.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._snapshot: Snapshot = EMPTY_SNAPSHOT
+
+    def swap(self, snapshot: Snapshot) -> None:
+        snapshot.encode()       # render once, off the scrape path
+        snapshot.encode_gzip()  # likewise the gzip body
+        with self._lock:
+            self._snapshot = snapshot
+
+    def current(self) -> Snapshot:
+        with self._lock:
+            return self._snapshot
+
+
+class CounterStore:
+    """Monotonic counter state that outlives individual snapshots.
+
+    Keyed by (metric name, label values). ``observe_total`` accepts an
+    absolute device counter (handles resets by clamping to monotonic);
+    ``inc`` adds a delta. Stale keys can be pruned by the collector when the
+    underlying entity (chip/link) disappears.
+    """
+
+    def __init__(self) -> None:
+        self._values: dict[tuple[str, tuple[str, ...]], float] = {}
+        self._raw: dict[tuple[str, tuple[str, ...]], float] = {}
+
+    def inc(self, name: str, labels: tuple[str, ...], delta: float = 1.0) -> float:
+        key = (name, labels)
+        self._values[key] = self._values.get(key, 0.0) + max(delta, 0.0)
+        return self._values[key]
+
+    def observe_total(self, name: str, labels: tuple[str, ...], raw_total: float) -> float:
+        """Fold an absolute monotonic reading into the exported counter.
+
+        If the raw counter goes backwards (device reset, runtime restart) the
+        exported counter holds instead of regressing.
+        """
+        key = (name, labels)
+        prev_raw = self._raw.get(key)
+        if prev_raw is None:
+            self._values.setdefault(key, raw_total if raw_total >= 0 else 0.0)
+        else:
+            delta = raw_total - prev_raw
+            if delta > 0:
+                self._values[key] = self._values.get(key, 0.0) + delta
+        self._raw[key] = raw_total
+        return self._values[key]
+
+    def get(self, name: str, labels: tuple[str, ...]) -> float:
+        return self._values.get((name, labels), 0.0)
+
+    def items_for(self, name: str) -> list[tuple[tuple[str, ...], float]]:
+        return [(k[1], v) for k, v in self._values.items() if k[0] == name]
+
+    def prune(self, keep: set[tuple[str, tuple[str, ...]]]) -> int:
+        """Drop counter state for entities that no longer exist."""
+        stale = [k for k in self._values if k not in keep]
+        for k in stale:
+            self._values.pop(k, None)
+            self._raw.pop(k, None)
+        return len(stale)

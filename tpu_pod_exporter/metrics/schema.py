@@ -1,0 +1,184 @@
+"""The ``tpu_*`` metric schema — the exporter's public contract.
+
+Replaces the reference's two inconsistently-named gauges
+(``pod_gpu_memory_usage`` / ``docker_gpu_memory_perc_usage``,
+``main.go:21-36``) with a consistent ``tpu_`` namespace, and fixes the
+reference's label-schema defects:
+
+- adds ``chip_id`` — the reference has no device label, so two processes of
+  one pod on different devices collapse into one series
+  (``main.go:123-155``);
+- adds ``namespace`` — the reference keys only by pod name, so equal names
+  in different namespaces collide (``main.go:113``);
+- adds ``container`` — the reference harvests per-container but never
+  attributes per-container (``main.go:92-110``);
+- adds slice/host topology labels for multi-host aggregation in Prometheus
+  (cross-host rollups are label joins, not exporter-to-exporter traffic).
+
+Semantic shift, documented rather than faked: NVML reports *per-process*
+device memory (``main.go:135,147``); TPU runtimes pin whole chips to one
+container, so the honest TPU analog is per-chip metrics labeled with the
+owning pod. There is no ``pid`` label by design.
+"""
+
+from __future__ import annotations
+
+from tpu_pod_exporter.metrics.registry import COUNTER, GAUGE, MetricSpec
+
+# Labels identifying one chip on one host, plus its pod attribution and the
+# slice topology it belongs to. Empty-string pod/namespace/container means
+# "chip not allocated to any pod" — per-chip hardware series exist regardless
+# of attribution.
+CHIP_LABELS: tuple[str, ...] = (
+    "chip_id",        # stable per-host chip index, e.g. "0".."3" on v4-8
+    "device_path",    # e.g. /dev/accel0 (or vfio path); "" for fakes
+    "accelerator",    # accelerator type, e.g. "v5p-64"
+    "slice_name",     # GKE TPU slice / nodepool identity
+    "host",           # node/host name
+    "worker_id",      # worker index within a multi-host slice
+    "pod",
+    "namespace",
+    "container",
+)
+
+ICI_LABELS: tuple[str, ...] = CHIP_LABELS + ("link",)
+
+# --- Device metrics (analog of main.go:147-150, redesigned) -----------------
+
+TPU_HBM_USED_BYTES = MetricSpec(
+    name="tpu_hbm_used_bytes",
+    help="High-bandwidth memory in use on this TPU chip, in bytes.",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+TPU_HBM_TOTAL_BYTES = MetricSpec(
+    name="tpu_hbm_total_bytes",
+    help="Total high-bandwidth memory capacity of this TPU chip, in bytes.",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+# Percent analog of docker_gpu_memory_perc_usage (main.go:149-150), per chip.
+TPU_HBM_USED_PERCENT = MetricSpec(
+    name="tpu_hbm_used_percent",
+    help="Percent of this TPU chip's HBM capacity currently in use (0-100).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+TPU_TENSORCORE_DUTY_CYCLE_PERCENT = MetricSpec(
+    name="tpu_tensorcore_duty_cycle_percent",
+    help="Percent of time the chip's TensorCore was busy over the last sample window (0-100).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+# --- ICI (inter-chip interconnect) metrics ----------------------------------
+
+TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_ici_link_bandwidth_bytes_per_second",
+    help="Observed ICI traffic rate on one inter-chip link since the previous poll.",
+    type=GAUGE,
+    label_names=ICI_LABELS,
+)
+
+TPU_ICI_TRANSFERRED_BYTES_TOTAL = MetricSpec(
+    name="tpu_ici_transferred_bytes_total",
+    help="Cumulative bytes transferred over one inter-chip link.",
+    type=COUNTER,
+    label_names=ICI_LABELS,
+)
+
+# --- Pod-level rollups -------------------------------------------------------
+
+POD_LABELS: tuple[str, ...] = ("pod", "namespace", "accelerator", "slice_name", "host", "worker_id")
+
+TPU_POD_CHIP_COUNT = MetricSpec(
+    name="tpu_pod_chip_count",
+    help="Number of TPU chips currently allocated to this pod on this host.",
+    type=GAUGE,
+    label_names=POD_LABELS,
+)
+
+TPU_POD_HBM_USED_BYTES = MetricSpec(
+    name="tpu_pod_hbm_used_bytes",
+    help="Sum of HBM bytes in use across all chips allocated to this pod on this host.",
+    type=GAUGE,
+    label_names=POD_LABELS,
+)
+
+# --- Exporter self-metrics (SURVEY.md §5: tracing/observability) -------------
+
+TPU_EXPORTER_UP = MetricSpec(
+    name="tpu_exporter_up",
+    help="1 if the most recent poll completed without fatal error, else 0.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_POLL_DURATION_SECONDS = MetricSpec(
+    name="tpu_exporter_poll_duration_seconds",
+    help="Duration of the most recent poll, by phase (device_read, attribution, join, publish, total).",
+    type=GAUGE,
+    label_names=("phase",),
+)
+
+TPU_EXPORTER_POLL_ERRORS_TOTAL = MetricSpec(
+    name="tpu_exporter_poll_errors_total",
+    help="Count of poll-phase errors since exporter start, by source.",
+    type=COUNTER,
+    label_names=("source",),
+)
+
+TPU_EXPORTER_POLLS_TOTAL = MetricSpec(
+    name="tpu_exporter_polls_total",
+    help="Count of completed poll iterations since exporter start.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_SERIES = MetricSpec(
+    name="tpu_exporter_series",
+    help="Number of time series in the current snapshot.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS = MetricSpec(
+    name="tpu_exporter_last_poll_timestamp_seconds",
+    help="Unix timestamp of the most recent completed poll.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_INFO = MetricSpec(
+    name="tpu_exporter_info",
+    help="Static exporter build/runtime info; value is always 1.",
+    type=GAUGE,
+    label_names=("version", "backend", "attribution"),
+)
+
+ALL_SPECS: tuple[MetricSpec, ...] = (
+    TPU_HBM_USED_BYTES,
+    TPU_HBM_TOTAL_BYTES,
+    TPU_HBM_USED_PERCENT,
+    TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
+    TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
+    TPU_ICI_TRANSFERRED_BYTES_TOTAL,
+    TPU_POD_CHIP_COUNT,
+    TPU_POD_HBM_USED_BYTES,
+    TPU_EXPORTER_UP,
+    TPU_EXPORTER_POLL_DURATION_SECONDS,
+    TPU_EXPORTER_POLL_ERRORS_TOTAL,
+    TPU_EXPORTER_POLLS_TOTAL,
+    TPU_EXPORTER_SERIES,
+    TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS,
+    TPU_EXPORTER_INFO,
+)
+
+
+def hbm_used_percent(used_bytes: float, total_bytes: float) -> float:
+    """Bytes → percent-of-device-total (analog of ``main.go:149-150``).
+
+    Returns 0.0 when capacity is unknown/zero instead of dividing by zero.
+    """
+    if total_bytes <= 0:
+        return 0.0
+    return (float(used_bytes) / float(total_bytes)) * 100.0

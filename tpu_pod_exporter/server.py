@@ -1,0 +1,120 @@
+"""HTTP export surface — ``GET /metrics`` plus health endpoints.
+
+Analog of the reference's promhttp goroutine (``main.go:67-72``), with the
+scrape path made collection-free *and* render-free: the poll loop pre-encodes
+the exposition text into the SnapshotStore, so a scrape is one lock, one
+reference read, and one ``sendall`` of cached bytes. This is what keeps p99
+scrape latency flat regardless of chip count (SURVEY.md §3.3, §7 "hard
+parts").
+
+Additional endpoints the reference lacks:
+- ``/healthz`` — liveness (process up, returns 200 always).
+- ``/readyz`` — readiness (200 once at least one poll has completed, 503
+  before; lets a DaemonSet rolling update wait for real data).
+
+The server is a stdlib ThreadingHTTPServer: no event-loop dependency, a few
+concurrent scrapers at most (Prometheus), and request handling does no
+per-request allocation beyond headers.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from tpu_pod_exporter.metrics import SnapshotStore
+
+log = logging.getLogger("tpu_pod_exporter.server")
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    # set by server factory
+    store: SnapshotStore
+    protocol_version = "HTTP/1.1"
+
+    def do_GET(self) -> None:  # noqa: N802 — stdlib API
+        path = self.path.split("?", 1)[0]
+        if path == "/metrics":
+            self._serve_metrics()
+        elif path == "/healthz":
+            self._serve_text(200, b"ok\n")
+        elif path == "/readyz":
+            snap = self.store.current()
+            if snap.timestamp > 0:
+                self._serve_text(200, b"ready\n")
+            else:
+                self._serve_text(503, b"no poll completed yet\n")
+        elif path == "/":
+            self._serve_text(
+                200,
+                b"tpu-pod-exporter\n/metrics /healthz /readyz\n",
+            )
+        else:
+            self._serve_text(404, b"not found\n")
+
+    def _serve_metrics(self) -> None:
+        snap = self.store.current()
+        headers = [("Content-Type", CONTENT_TYPE)]
+        if "gzip" in (self.headers.get("Accept-Encoding") or ""):
+            body = snap.encode_gzip()  # pre-compressed at poll time
+            headers.append(("Content-Encoding", "gzip"))
+        else:
+            body = snap.encode()
+        self.send_response(200)
+        for k, v in headers:
+            self.send_header(k, v)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _serve_text(self, code: int, body: bytes) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", "text/plain; charset=utf-8")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt: str, *args) -> None:  # quiet access logs
+        log.debug("http: " + fmt, *args)
+
+
+class _Server(ThreadingHTTPServer):
+    # Python ≥3.11 sets SO_REUSEPORT on ThreadingHTTPServer, which lets a
+    # second exporter instance bind the same port and silently steal scrapes.
+    # Fail loudly on a port conflict instead.
+    allow_reuse_port = False
+    daemon_threads = True
+
+
+class MetricsServer:
+    """Owns the listener thread. Unlike the reference (hardcoded ``:8000``,
+    ``log.Fatal`` on listener death, ``main.go:71``), port 0 is allowed for
+    tests (ephemeral) and shutdown is clean."""
+
+    def __init__(self, store: SnapshotStore, host: str = "0.0.0.0", port: int = 8000) -> None:
+        handler = type("BoundHandler", (_Handler,), {"store": store})
+        self._httpd = _Server((host, port), handler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            raise RuntimeError("already started")
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="tpu-exporter-http", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
