@@ -1,0 +1,147 @@
+// libtpumon — native helpers for tpu-pod-exporter.
+//
+// TPU-native analog of the reference's single native component (the NVML C
+// library reached via cgo, reference main.go:16,44-54,116-138; SURVEY.md
+// §2.7 "native-component ledger"). Two jobs:
+//
+//   1. Device discovery: scan /dev for accel*/vfio nodes without opening
+//      them (no runtime lock, no ioctls).
+//   2. Exposition rendering: format `prefix value\n` lines for thousands of
+//      series per poll. Called once per poll, never per scrape — but at a
+//      1 s interval × 256 chips × ~10 series × 7 links this is the hottest
+//      CPU in the process, and the <1% node CPU budget is the point.
+//
+// Pure C ABI (loaded via ctypes — no pybind11 in the image); every function
+// is safe to call from any thread; no global state.
+
+#include <cstdio>
+#include <cstring>
+#include <cstdint>
+#include <cstdlib>
+#include <cmath>
+
+#include <dirent.h>
+#include <sys/stat.h>
+
+namespace {
+
+bool is_all_digits(const char* s) {
+  if (!*s) return false;
+  for (; *s; ++s)
+    if (*s < '0' || *s > '9') return false;
+  return true;
+}
+
+// Scan root/dev for TPU device nodes. Returns count; if out != null, writes
+// newline-separated "/dev/<name>" paths (relative to root) up to cap bytes.
+int scan_devices(const char* root, char* out, long cap) {
+  char dev_path[4096];
+  std::snprintf(dev_path, sizeof(dev_path), "%s/dev", root ? root : "/");
+
+  int count = 0;
+  long used = 0;
+
+  DIR* d = opendir(dev_path);
+  if (d != nullptr) {
+    struct dirent* e;
+    while ((e = readdir(d)) != nullptr) {
+      if (std::strncmp(e->d_name, "accel", 5) == 0 && is_all_digits(e->d_name + 5)) {
+        ++count;
+        if (out != nullptr) {
+          int n = std::snprintf(out + used, cap > used ? cap - used : 0,
+                                "/dev/%s\n", e->d_name);
+          if (n > 0 && used + n < cap) used += n;
+        }
+      }
+    }
+    closedir(d);
+  }
+
+  if (count == 0) {
+    // vfio fallback (v6e+): /dev/vfio/<N> numeric nodes.
+    char vfio_path[4096];
+    std::snprintf(vfio_path, sizeof(vfio_path), "%s/dev/vfio", root ? root : "/");
+    DIR* v = opendir(vfio_path);
+    if (v != nullptr) {
+      struct dirent* e;
+      while ((e = readdir(v)) != nullptr) {
+        if (is_all_digits(e->d_name)) {
+          ++count;
+          if (out != nullptr) {
+            int n = std::snprintf(out + used, cap > used ? cap - used : 0,
+                                  "/dev/vfio/%s\n", e->d_name);
+            if (n > 0 && used + n < cap) used += n;
+          }
+        }
+      }
+      closedir(v);
+    }
+  }
+
+  if (out != nullptr && cap > 0) out[used < cap ? used : cap - 1] = '\0';
+  return count;
+}
+
+// Format one sample value, Prometheus-style. Matches the Python encoder's
+// contract (integral values without exponent/decimal, shortest-round-trip
+// otherwise, NaN/+Inf/-Inf spelled out).
+inline int format_value(double v, char* out, int cap) {
+  if (std::isnan(v)) return std::snprintf(out, cap, "NaN");
+  if (std::isinf(v)) return std::snprintf(out, cap, v > 0 ? "+Inf" : "-Inf");
+  if (v == std::floor(v) && std::fabs(v) < 9007199254740992.0 /* 2^53 */) {
+    return std::snprintf(out, cap, "%lld", (long long)v);
+  }
+  // %.17g always round-trips; try %.15g / %.16g first for shorter output.
+  char tmp[64];
+  for (int prec = 15; prec <= 17; ++prec) {
+    std::snprintf(tmp, sizeof(tmp), "%.*g", prec, v);
+    if (std::strtod(tmp, nullptr) == v) break;
+  }
+  return std::snprintf(out, cap, "%s", tmp);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Number of local TPU device nodes under root ("/" in production; test
+// trees elsewhere). Never opens a device. Returns -1 on null root.
+int tpumon_count_devices(const char* root) {
+  if (root == nullptr) return -1;
+  return scan_devices(root, nullptr, 0);
+}
+
+// Write newline-separated device paths into out (cap bytes, NUL-terminated).
+// Returns the device count (which may exceed what fit in the buffer).
+int tpumon_list_devices(const char* root, char* out, long cap) {
+  if (root == nullptr || out == nullptr || cap <= 0) return -1;
+  return scan_devices(root, out, cap);
+}
+
+// Render n exposition lines "prefix value\n" into out. prefixes[i] is the
+// precomputed `metric{label="…"}` part (UTF-8, no trailing space). Returns
+// bytes written, or -1 if out was too small (caller grows and retries).
+long tpumon_render(const char** prefixes, const double* values, long n,
+                   char* out, long cap) {
+  if (prefixes == nullptr || values == nullptr || out == nullptr) return -1;
+  long used = 0;
+  char val[64];
+  for (long i = 0; i < n; ++i) {
+    const char* p = prefixes[i];
+    long plen = (long)std::strlen(p);
+    int vlen = format_value(values[i], val, sizeof(val));
+    if (used + plen + 1 + vlen + 1 > cap) return -1;
+    std::memcpy(out + used, p, plen);
+    used += plen;
+    out[used++] = ' ';
+    std::memcpy(out + used, val, vlen);
+    used += vlen;
+    out[used++] = '\n';
+  }
+  return used;
+}
+
+// ABI version for the ctypes loader to sanity-check.
+int tpumon_abi_version(void) { return 1; }
+
+}  // extern "C"

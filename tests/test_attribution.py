@@ -1,0 +1,236 @@
+"""Attribution tests: pure conversion, real gRPC over unix sockets, checkpoint
+fallback, fault paths (SURVEY.md §4.2, §4.5)."""
+
+import json
+import os
+import threading
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_pod_exporter.attribution import (
+    AttributionError,
+    AttributionSnapshot,
+    DeviceAllocation,
+    TPU_RESOURCE_NAME,
+)
+from tpu_pod_exporter.attribution.checkpoint import (
+    CheckpointAttribution,
+    parse_checkpoint,
+)
+from tpu_pod_exporter.attribution.podresources import (
+    LIST_METHOD,
+    PodResourcesAttribution,
+    snapshot_from_response,
+)
+from tpu_pod_exporter.attribution.proto import podresources_pb2 as pb
+
+
+def make_response(pods):
+    """pods: [(name, ns, [(container, resource, [ids])])]"""
+    resp = pb.ListPodResourcesResponse()
+    for name, ns, containers in pods:
+        p = resp.pod_resources.add()
+        p.name, p.namespace = name, ns
+        for cname, resource, ids in containers:
+            c = p.containers.add()
+            c.name = cname
+            if ids is not None:
+                d = c.devices.add()
+                d.resource_name = resource
+                d.device_ids.extend(ids)
+    return resp
+
+
+class TestSnapshotFromResponse:
+    def test_basic(self):
+        resp = make_response(
+            [("train-0", "ml", [("main", TPU_RESOURCE_NAME, ["0", "1"])])]
+        )
+        snap = snapshot_from_response(resp)
+        assert snap.allocations == (
+            DeviceAllocation("train-0", "ml", "main", ("0", "1"), TPU_RESOURCE_NAME),
+        )
+        assert snap.by_device_id() == {
+            "0": snap.allocations[0],
+            "1": snap.allocations[0],
+        }
+
+    def test_non_tpu_resources_pass_through_but_join_filters(self):
+        resp = make_response(
+            [("pod", "ns", [("c", "nvidia.com/gpu", ["GPU-abc"])])]
+        )
+        snap = snapshot_from_response(resp)
+        assert len(snap.allocations) == 1
+        assert snap.by_device_id(TPU_RESOURCE_NAME) == {}
+
+    def test_resource_prefix_filter(self):
+        resp = make_response(
+            [
+                ("pod", "ns", [("c", "nvidia.com/gpu", ["x"])]),
+                ("pod2", "ns", [("c", TPU_RESOURCE_NAME, ["0"])]),
+            ]
+        )
+        snap = snapshot_from_response(resp, resource_prefixes=("google.com/",))
+        assert len(snap.allocations) == 1
+        assert snap.allocations[0].pod == "pod2"
+
+    def test_deviceless_containers_skipped(self):
+        resp = make_response([("pod", "ns", [("c", TPU_RESOURCE_NAME, None)])])
+        assert snapshot_from_response(resp).allocations == ()
+
+    def test_duplicate_device_id_first_claim_wins(self):
+        snap = AttributionSnapshot(
+            (
+                DeviceAllocation("a", "ns", "c", ("0",)),
+                DeviceAllocation("b", "ns", "c", ("0",)),
+            )
+        )
+        assert snap.by_device_id()["0"].pod == "a"
+
+
+class _FakeLister:
+    """Scripted PodResourcesLister served over a real unix socket."""
+
+    def __init__(self, response):
+        self.response = response
+        self.calls = 0
+
+    def __call__(self, request, context):
+        self.calls += 1
+        return self.response
+
+
+def serve_lister(socket_path, lister):
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    handler = grpc.method_handlers_generic_handler(
+        "v1.PodResourcesLister",
+        {
+            "List": grpc.unary_unary_rpc_method_handler(
+                lister,
+                request_deserializer=pb.ListPodResourcesRequest.FromString,
+                response_serializer=pb.ListPodResourcesResponse.SerializeToString,
+            )
+        },
+    )
+    server.add_generic_rpc_handlers((handler,))
+    server.add_insecure_port(f"unix://{socket_path}")
+    server.start()
+    return server
+
+
+class TestPodResourcesGrpc:
+    def test_end_to_end_over_unix_socket(self, tmp_path):
+        sock = str(tmp_path / "kubelet.sock")
+        lister = _FakeLister(
+            make_response(
+                [("train-0", "ml", [("main", TPU_RESOURCE_NAME, ["0", "1", "2", "3"])])]
+            )
+        )
+        server = serve_lister(sock, lister)
+        try:
+            provider = PodResourcesAttribution(socket_path=sock)
+            snap = provider.snapshot()
+            assert snap.allocations[0].pod == "train-0"
+            assert snap.allocations[0].device_ids == ("0", "1", "2", "3")
+            # channel reused across polls
+            provider.snapshot()
+            assert lister.calls == 2
+            provider.close()
+        finally:
+            server.stop(0)
+
+    def test_missing_socket_raises_attribution_error(self, tmp_path):
+        provider = PodResourcesAttribution(
+            socket_path=str(tmp_path / "nope.sock"), timeout_s=0.2
+        )
+        with pytest.raises(AttributionError):
+            provider.snapshot()
+        provider.close()
+
+    def test_kubelet_restart_reconnects(self, tmp_path):
+        sock = str(tmp_path / "kubelet.sock")
+        lister = _FakeLister(make_response([("p", "ns", [("c", TPU_RESOURCE_NAME, ["0"])])]))
+        server = serve_lister(sock, lister)
+        provider = PodResourcesAttribution(socket_path=sock, timeout_s=0.5)
+        assert provider.snapshot().allocations[0].pod == "p"
+        server.stop(0)
+        if os.path.exists(sock):  # grpc may remove the socket file on stop
+            os.unlink(sock)
+        with pytest.raises(AttributionError):
+            provider.snapshot()
+        # kubelet comes back on the same path
+        lister2 = _FakeLister(make_response([("q", "ns", [("c", TPU_RESOURCE_NAME, ["0"])])]))
+        server2 = serve_lister(sock, lister2)
+        try:
+            assert provider.snapshot().allocations[0].pod == "q"
+        finally:
+            provider.close()
+            server2.stop(0)
+
+
+CHECKPOINT_V2 = {
+    "Data": {
+        "PodDeviceEntries": [
+            {
+                "PodUID": "uid-123",
+                "ContainerName": "main",
+                "ResourceName": TPU_RESOURCE_NAME,
+                "DeviceIDs": {"-1": ["0", "1"]},
+            }
+        ],
+        "RegisteredDevices": {TPU_RESOURCE_NAME: ["0", "1", "2", "3"]},
+    },
+    "Checksum": 12345,
+}
+
+
+class TestCheckpoint:
+    def test_parse_v2_shape(self):
+        snap = parse_checkpoint(json.dumps(CHECKPOINT_V2))
+        assert snap.allocations == (
+            DeviceAllocation("uid:uid-123", "", "main", ("0", "1"), TPU_RESOURCE_NAME),
+        )
+
+    def test_parse_legacy_flat_shape(self):
+        doc = {
+            "Data": {
+                "PodDeviceEntries": [
+                    {
+                        "PodUID": "u",
+                        "ContainerName": "c",
+                        "ResourceName": TPU_RESOURCE_NAME,
+                        "DeviceIDs": ["3"],
+                    }
+                ]
+            }
+        }
+        snap = parse_checkpoint(json.dumps(doc))
+        assert snap.allocations[0].device_ids == ("3",)
+
+    def test_uid_hint_map(self):
+        snap = parse_checkpoint(
+            json.dumps(CHECKPOINT_V2), uid_to_pod={"uid-123": ("train-0", "ml")}
+        )
+        assert snap.allocations[0].pod == "train-0"
+        assert snap.allocations[0].namespace == "ml"
+
+    def test_bad_json_raises(self):
+        with pytest.raises(AttributionError):
+            parse_checkpoint("{not json")
+
+    def test_empty_and_malformed_entries_skipped(self):
+        doc = {"Data": {"PodDeviceEntries": [None, {}, {"PodUID": "u", "DeviceIDs": {}}]}}
+        assert parse_checkpoint(json.dumps(doc)).allocations == ()
+
+    def test_provider_reads_file(self, tmp_path):
+        path = tmp_path / "kubelet_internal_checkpoint"
+        path.write_text(json.dumps(CHECKPOINT_V2))
+        provider = CheckpointAttribution(path=str(path))
+        assert provider.snapshot().allocations[0].device_ids == ("0", "1")
+
+    def test_provider_missing_file_raises(self, tmp_path):
+        provider = CheckpointAttribution(path=str(tmp_path / "missing"))
+        with pytest.raises(AttributionError):
+            provider.snapshot()

@@ -1,0 +1,67 @@
+"""Device discovery tests against fake /dev trees."""
+
+from tpu_pod_exporter.backend.discovery import (
+    discover_chips,
+    list_device_paths,
+    local_chip_count,
+)
+
+
+def make_dev_tree(tmp_path, names):
+    (tmp_path / "dev").mkdir(exist_ok=True)
+    for n in names:
+        (tmp_path / "dev" / n).touch()
+    return str(tmp_path)
+
+
+class TestDiscovery:
+    def test_accel_nodes(self, tmp_path):
+        root = make_dev_tree(tmp_path, ["accel0", "accel1", "accel2", "accel3"])
+        assert local_chip_count(root) == 4
+        chips = discover_chips(root)
+        assert [c.chip_id for c in chips] == [0, 1, 2, 3]
+        assert chips[0].device_path.endswith("/dev/accel0")
+        assert chips[2].device_ids == ("2",)
+
+    def test_numeric_sort_not_lexicographic(self, tmp_path):
+        root = make_dev_tree(tmp_path, [f"accel{i}" for i in range(12)])
+        chips = discover_chips(root)
+        assert [c.chip_id for c in chips] == list(range(12))
+
+    def test_vfio_nodes(self, tmp_path):
+        (tmp_path / "dev" / "vfio").mkdir(parents=True)
+        for i in range(4):
+            (tmp_path / "dev" / "vfio" / str(i)).touch()
+        paths = list_device_paths(str(tmp_path))
+        assert len(paths) == 4
+
+    def test_empty_host(self, tmp_path):
+        assert local_chip_count(str(tmp_path)) == 0
+        assert discover_chips(str(tmp_path)) == []
+
+    def test_non_numeric_accel_suffix_ignored(self, tmp_path):
+        root = make_dev_tree(tmp_path, ["accel0", "accelfoo", "accel_dbg"])
+        assert local_chip_count(root) == 1
+        assert [c.chip_id for c in discover_chips(root)] == [0]
+
+    def test_vfio_ignored_when_accel_present(self, tmp_path):
+        root = make_dev_tree(tmp_path, ["accel0", "accel1"])
+        (tmp_path / "dev" / "vfio").mkdir()
+        (tmp_path / "dev" / "vfio" / "7").touch()  # unrelated passthrough group
+        assert local_chip_count(root) == 2
+        assert len(list_device_paths(root)) == 2
+
+    def test_python_and_native_scans_agree(self, tmp_path):
+        from tpu_pod_exporter import nativelib
+
+        lib = nativelib.load()
+        if lib is None:
+            import pytest
+
+            pytest.skip("native lib not built")
+        for names in (["accel0", "accel1", "accelx"], [], ["accel3"]):
+            import shutil
+
+            shutil.rmtree(tmp_path / "dev", ignore_errors=True)
+            root = make_dev_tree(tmp_path, names)
+            assert lib.tpumon_count_devices(root.encode()) == local_chip_count(root)

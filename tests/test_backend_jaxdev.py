@@ -1,0 +1,24 @@
+"""JAX device backend tests — run on whatever JAX exposes locally (CPU
+devices in CI; the tunneled TPU chip when present). memory_stats() may be
+None/raise off-TPU; the backend must degrade to zeroed HBM, never crash."""
+
+import pytest
+
+from tpu_pod_exporter.backend import BackendError
+from tpu_pod_exporter.backend.jaxdev import JaxDeviceBackend
+
+
+class TestJaxDeviceBackend:
+    def test_sample_any_platform(self):
+        backend = JaxDeviceBackend(platform=None)
+        sample = backend.sample()
+        assert len(sample.chips) >= 1
+        for chip in sample.chips:
+            assert chip.hbm_used_bytes >= 0
+            assert chip.hbm_total_bytes >= 0
+            assert chip.info.device_ids == (str(chip.info.chip_id),)
+
+    def test_unknown_platform_raises_backend_error(self):
+        backend = JaxDeviceBackend(platform="nonexistent_platform")
+        with pytest.raises(BackendError):
+            backend.sample()

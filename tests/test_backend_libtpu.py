@@ -1,0 +1,201 @@
+"""libtpu metrics backend tests against a scripted RuntimeMetricService
+served over real gRPC (SURVEY.md §4.2: fake backends behind real seams)."""
+
+from concurrent import futures
+
+import grpc
+import pytest
+
+from tpu_pod_exporter.backend import BackendError
+from tpu_pod_exporter.backend.libtpu import (
+    DUTY_CYCLE,
+    HBM_TOTAL,
+    HBM_USAGE,
+    ICI_TRANSFERRED,
+    LibtpuMetricsBackend,
+)
+from tpu_pod_exporter.backend.proto import tpu_metric_service_pb2 as pb
+
+
+def metric_response(rows):
+    """rows: [(device_id:int, value:float|int)]"""
+    resp = pb.MetricResponse()
+    for dev, value in rows:
+        m = resp.metric.metrics.add()
+        m.attribute.key = "device-id"
+        m.attribute.value.int_attr = dev
+        if isinstance(value, int):
+            m.gauge.as_int = value
+        else:
+            m.gauge.as_double = value
+    return resp
+
+
+class _FakeMetricService:
+    def __init__(self):
+        self.tables = {}
+        self.fail_metrics = set()
+        self.calls = []
+
+    def set(self, metric_name, rows):
+        self.tables[metric_name] = metric_response(rows)
+
+    def __call__(self, request, context):
+        self.calls.append(request.metric_name)
+        if request.metric_name in self.fail_metrics:
+            context.abort(grpc.StatusCode.UNAVAILABLE, "injected")
+        if request.metric_name not in self.tables:
+            context.abort(grpc.StatusCode.NOT_FOUND, "unsupported metric")
+        return self.tables[request.metric_name]
+
+
+@pytest.fixture
+def metric_server(tmp_path):
+    service = _FakeMetricService()
+    server = grpc.server(futures.ThreadPoolExecutor(max_workers=2))
+    handler = grpc.method_handlers_generic_handler(
+        "tpu.monitoring.runtime.RuntimeMetricService",
+        {
+            "GetRuntimeMetric": grpc.unary_unary_rpc_method_handler(
+                service,
+                request_deserializer=pb.MetricRequest.FromString,
+                response_serializer=pb.MetricResponse.SerializeToString,
+            )
+        },
+    )
+    server.add_generic_rpc_handlers((handler,))
+    sock = str(tmp_path / "libtpu.sock")
+    server.add_insecure_port(f"unix://{sock}")
+    server.start()
+    yield service, f"unix://{sock}"
+    server.stop(0)
+
+
+GIB = 1024**3
+
+
+class TestLibtpuBackend:
+    def test_full_sample(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, 10 * GIB), (1, 20 * GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB), (1, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 55.5), (1, 0.0)])
+        service.set(ICI_TRANSFERRED, [(0, 1000), (1, 2000)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={0: "/dev/accel0", 1: "/dev/accel1"})
+        sample = backend.sample()
+        assert len(sample.chips) == 2
+        c0, c1 = sample.chips
+        assert c0.info.chip_id == 0 and c0.info.device_path == "/dev/accel0"
+        assert c0.hbm_used_bytes == 10 * GIB
+        assert c0.hbm_total_bytes == 32 * GIB
+        assert c0.tensorcore_duty_cycle_percent == 55.5
+        assert c0.ici_links[0].transferred_bytes_total == 1000
+        assert c1.info.device_ids == ("1",)
+        assert sample.partial_errors == ()
+        backend.close()
+
+    def test_duty_cycle_failure_is_partial(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.fail_metrics.add(DUTY_CYCLE)
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        assert len(sample.chips) == 1
+        assert sample.chips[0].tensorcore_duty_cycle_percent is None
+        assert len(sample.partial_errors) == 1
+        backend.close()
+
+    def test_ici_unsupported_probed_once(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        backend.sample()
+        backend.sample()
+        assert service.calls.count(ICI_TRANSFERRED) == 1  # not re-probed
+        assert backend.sample().chips[0].ici_links == ()
+        backend.close()
+
+    def test_hbm_failure_is_fatal_backend_error(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.fail_metrics.add(HBM_USAGE)
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        with pytest.raises(BackendError):
+            backend.sample()
+        backend.close()
+
+    def test_no_service_raises_backend_error(self, tmp_path):
+        backend = LibtpuMetricsBackend(
+            addr=f"unix://{tmp_path}/absent.sock", timeout_s=0.2, device_paths={}
+        )
+        with pytest.raises(BackendError):
+            backend.sample()
+        backend.close()
+
+    def test_recovers_after_service_restart(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        assert backend.sample().chips
+        service.fail_metrics.update({HBM_USAGE})
+        with pytest.raises(BackendError):
+            backend.sample()
+        service.fail_metrics.clear()
+        assert backend.sample().chips
+        backend.close()
+
+    def test_ici_transient_failure_after_success_is_partial_not_latched(
+        self, metric_server
+    ):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+        service.set(ICI_TRANSFERRED, [(0, 100)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        assert backend.sample().chips[0].ici_links  # supported
+        service.fail_metrics.add(ICI_TRANSFERRED)
+        sample = backend.sample()
+        assert sample.chips[0].ici_links == ()
+        assert any("ICI" in e for e in sample.partial_errors)
+        service.fail_metrics.clear()
+        assert backend.sample().chips[0].ici_links  # retried, not latched off
+        backend.close()
+
+    def test_mixed_device_ids_never_collide(self, metric_server):
+        service, addr = metric_server
+        resp = pb.MetricResponse()
+        for dev in ("1", "x"):
+            m = resp.metric.metrics.add()
+            m.attribute.key = "device-id"
+            m.attribute.value.string_attr = dev
+            m.gauge.as_int = GIB
+        service.tables[HBM_USAGE] = resp
+        service.tables[HBM_TOTAL] = resp
+        service.set(DUTY_CYCLE, [])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        ids = [c.info.chip_id for c in sample.chips]
+        assert len(set(ids)) == 2  # unique even with non-numeric device ids
+        backend.close()
+
+    def test_string_device_ids(self, metric_server):
+        service, addr = metric_server
+        resp = pb.MetricResponse()
+        m = resp.metric.metrics.add()
+        m.attribute.key = "device-id"
+        m.attribute.value.string_attr = "7"
+        m.gauge.as_int = 5 * GIB
+        service.tables[HBM_USAGE] = resp
+        service.set(HBM_TOTAL, [(7, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(7, 1.0)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        assert sample.chips[0].info.chip_id == 7
+        assert sample.chips[0].hbm_total_bytes == 32 * GIB
+        backend.close()

@@ -1,0 +1,78 @@
+"""Native renderer parity tests — build libtpumon.so if a toolchain exists,
+then assert byte-level behavior matches the Python fallback's contract."""
+
+import ctypes
+import math
+import shutil
+import subprocess
+from pathlib import Path
+
+import pytest
+
+from tpu_pod_exporter.metrics import native
+from tpu_pod_exporter.metrics.registry import format_value
+
+REPO = Path(__file__).resolve().parent.parent
+SO = REPO / "native" / "libtpumon.so"
+
+
+@pytest.fixture(scope="module")
+def built_lib():
+    if not SO.exists():
+        if shutil.which("g++") is None:
+            pytest.skip("no libtpumon.so and no g++ to build it")
+        subprocess.run(["make"], cwd=REPO / "native", check=True, capture_output=True)
+    lib = native.load()
+    if lib is None:
+        pytest.skip("native lib not loadable")
+    return lib
+
+
+class TestNativeRender:
+    def test_parity_with_python_formatting(self, built_lib):
+        values = [0.0, 1.0, -1.0, 2.5, 1e18, 1.5e-9, 123456789.0,
+                  math.nan, math.inf, -math.inf, 0.1, 1 / 3]
+        prefixes = [f'm{{i="{i}"}}'.encode() for i in range(len(values))]
+        out = native.render_lines(prefixes, values)
+        assert out is not None
+        lines = out.decode().strip().split("\n")
+        assert len(lines) == len(values)
+        for line, prefix, v in zip(lines, prefixes, values):
+            got_prefix, got_val = line.rsplit(" ", 1)
+            assert got_prefix == prefix.decode()
+            # native may choose different digits than repr(); must round-trip
+            if math.isnan(v):
+                assert got_val == "NaN"
+            elif math.isinf(v):
+                assert got_val == ("+Inf" if v > 0 else "-Inf")
+            else:
+                assert float(got_val) == v
+                # integral values render without decimal point, like Python's
+                if v == int(v) and abs(v) < 2**53:
+                    assert got_val == format_value(v)
+
+    def test_empty_input(self, built_lib):
+        assert native.render_lines([], []) is None  # caller falls back
+
+    def test_device_scan_against_fake_tree(self, built_lib, tmp_path):
+        (tmp_path / "dev").mkdir()
+        for i in range(4):
+            (tmp_path / "dev" / f"accel{i}").touch()
+        (tmp_path / "dev" / "accelfoo").touch()  # non-numeric suffix ignored
+        built_lib.tpumon_count_devices.restype = ctypes.c_int
+        built_lib.tpumon_count_devices.argtypes = [ctypes.c_char_p]
+        assert built_lib.tpumon_count_devices(str(tmp_path).encode()) == 4
+
+    def test_snapshot_encode_uses_native_and_parses(self, built_lib):
+        from prometheus_client.parser import text_string_to_metric_families
+
+        from tpu_pod_exporter.metrics.registry import MetricSpec, SnapshotBuilder
+
+        b = SnapshotBuilder()
+        spec = MetricSpec(name="m", help="h", label_names=("a",))
+        for i in range(100):
+            b.add(spec, i * 1.5, (str(i),))
+        text = b.build().encode().decode()
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        assert len(fams["m"].samples) == 100
+        assert fams["m"].samples[3].value == 4.5

@@ -1,84 +1,61 @@
 """Local TPU device discovery — the analog of ``nvml.DeviceGetCount``
 (``main.go:116-120``), without opening any device.
 
-TPU VMs expose chips as ``/dev/accel{N}`` (v2-v5) or via vfio
-(``/dev/vfio/*``, v6e+); sysfs mirrors them under ``/sys/class/accel``.
-Discovery is a directory scan — no driver init, no runtime lock, safe to run
-next to a training job.
+TPU VMs expose chips as ``/dev/accel{N}`` (v2-v5); newer platforms use vfio
+(``/dev/vfio/{N}``). Discovery is a directory scan — no driver init, no
+runtime lock, safe to run next to a training job.
 
-A native C++ scanner (``native/tpumon.cc``) provides the same interface for
-the hot path; this module is the pure-Python implementation and the ctypes
-loader, falling back transparently when the shared library is absent.
+Scan semantics (identical in the native scanner, ``native/tpumon.cc``, and
+this pure-Python fallback — test-enforced):
+- ``/dev/accel<digits>`` nodes only (non-numeric suffixes are not chips);
+- vfio numeric nodes are consulted **only when zero accel nodes exist** —
+  on accel platforms, unrelated vfio groups (e.g. NIC passthrough) must not
+  inflate the chip count.
 """
 
 from __future__ import annotations
 
-import ctypes
-import glob
 import os
 import re
-from pathlib import Path
-
+from tpu_pod_exporter import nativelib
 from tpu_pod_exporter.backend import ChipInfo
 
-_ACCEL_GLOBS = ("/dev/accel*", "/dev/vfio/[0-9]*")
-_SYS_ACCEL = "/sys/class/accel"
-
-_native = None
-_native_tried = False
+_NUM = re.compile(r"^\d+$")
 
 
-def _load_native() -> ctypes.CDLL | None:
-    global _native, _native_tried
-    if _native_tried:
-        return _native
-    _native_tried = True
-    here = Path(__file__).resolve().parent.parent.parent
-    for cand in (
-        here / "native" / "libtpumon.so",
-        Path("/usr/local/lib/libtpumon.so"),
-    ):
-        if cand.exists():
-            try:
-                lib = ctypes.CDLL(str(cand))
-                lib.tpumon_count_devices.restype = ctypes.c_int
-                lib.tpumon_count_devices.argtypes = [ctypes.c_char_p]
-                _native = lib
-                break
-            except (OSError, AttributeError):
-                # unloadable, or loadable but missing the symbol (stale .so):
-                # fall back to the pure-Python scan either way
-                continue
-    return _native
+def _scan(root: str) -> list[str]:
+    dev = os.path.join(root, "dev")
+    accel: list[tuple[int, str]] = []
+    try:
+        for name in os.listdir(dev):
+            if name.startswith("accel") and _NUM.match(name[5:]):
+                accel.append((int(name[5:]), f"/dev/{name}"))
+    except OSError:
+        pass
+    if accel:
+        return [p for _, p in sorted(accel)]
+    vfio: list[tuple[int, str]] = []
+    try:
+        for name in os.listdir(os.path.join(dev, "vfio")):
+            if _NUM.match(name):
+                vfio.append((int(name), f"/dev/vfio/{name}"))
+    except OSError:
+        pass
+    return [p for _, p in sorted(vfio)]
 
 
 def list_device_paths(root: str = "/") -> list[str]:
     """Paths of local TPU device nodes, sorted by chip index."""
-    out: list[str] = []
-    for pattern in _ACCEL_GLOBS:
-        out.extend(glob.glob(os.path.join(root, pattern.lstrip("/"))))
-    sys_accel = os.path.join(root, _SYS_ACCEL.lstrip("/"))
-    if not out and os.path.isdir(sys_accel):
-        out = [
-            os.path.join("/dev", name)
-            for name in sorted(os.listdir(sys_accel))
-            if name.startswith("accel")
-        ]
-
-    def key(p: str) -> tuple[int, str]:
-        m = re.search(r"(\d+)$", p)
-        return (int(m.group(1)) if m else 1 << 30, p)
-
-    return sorted(set(out), key=key)
+    return _scan(root)
 
 
 def local_chip_count(root: str = "/") -> int:
-    lib = _load_native()
-    if lib is not None and root == "/":
-        n = lib.tpumon_count_devices(b"/")
+    lib = nativelib.load()
+    if lib is not None:
+        n = lib.tpumon_count_devices(root.encode())
         if n >= 0:
             return n
-    return len(list_device_paths(root))
+    return len(_scan(root))
 
 
 def discover_chips(root: str = "/") -> list[ChipInfo]:

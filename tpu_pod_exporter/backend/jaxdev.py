@@ -1,0 +1,82 @@
+"""JAX device backend — live HBM telemetry via ``Device.memory_stats()``.
+
+For dev/bench setups where the exporter is colocated *inside* the workload
+process's trust domain (it initializes the TPU runtime itself, which would
+starve a separate training job — hence never auto-selected; see
+``app.build_backend``). On real TPU hardware ``memory_stats()`` reports
+``bytes_in_use`` / ``bytes_limit`` straight from the allocator, making this
+the ground-truth cross-check for the libtpu metrics path, and the backend
+the benchmark harness uses on the one real chip available to CI.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from tpu_pod_exporter.backend import (
+    BackendError,
+    ChipInfo,
+    ChipSample,
+    DeviceBackend,
+    HostSample,
+)
+
+log = logging.getLogger("tpu_pod_exporter.backend.jaxdev")
+
+
+class JaxDeviceBackend(DeviceBackend):
+    name = "jax"
+
+    def __init__(self, platform: str | None = "tpu") -> None:
+        """``platform=None`` samples whatever JAX's default backend exposes
+        (CPU devices report no memory_stats and appear with zeroed HBM)."""
+        try:
+            import jax
+        except Exception as e:  # noqa: BLE001
+            raise BackendError(f"jax unavailable: {e}") from e
+        self._jax = jax
+        self._platform = platform
+        self._devices = None  # resolved lazily; first call may compile-init
+
+    def _local_devices(self):
+        if self._devices is None:
+            try:
+                if self._platform:
+                    self._devices = self._jax.local_devices(backend=self._platform)
+                else:
+                    self._devices = self._jax.local_devices()
+            except RuntimeError as e:
+                raise BackendError(f"jax device init failed: {e}") from e
+        return self._devices
+
+    def sample(self) -> HostSample:
+        devices = self._local_devices()
+        chips: list[ChipSample] = []
+        partial: list[str] = []
+        for d in devices:
+            used = 0.0
+            total = 0.0
+            try:
+                stats = d.memory_stats()
+                if stats is None:  # some runtimes (tunnels, CPU) expose none
+                    partial.append(f"device {d.id}: memory_stats returned None")
+                    stats = {}
+                used = float(stats.get("bytes_in_use", 0))
+                total = float(
+                    stats.get("bytes_limit", stats.get("bytes_reservable_limit", 0))
+                )
+            except Exception as e:  # noqa: BLE001 — CPU devices raise; report once
+                partial.append(f"device {d.id}: memory_stats unavailable: {e}")
+            chips.append(
+                ChipSample(
+                    info=ChipInfo(
+                        chip_id=int(d.id),
+                        device_path="",
+                        device_ids=(str(d.id),),
+                    ),
+                    hbm_used_bytes=used,
+                    hbm_total_bytes=total,
+                    tensorcore_duty_cycle_percent=None,  # not exposed via JAX
+                )
+            )
+        return HostSample(chips=tuple(chips), partial_errors=tuple(partial))

@@ -1,0 +1,217 @@
+"""libtpu runtime-metrics backend — the production telemetry path.
+
+TPU-native replacement for the reference's NVML layer (``main.go:116-138``):
+instead of cgo ioctls into a driver library, this reads the libtpu runtime's
+local gRPC metrics service (the endpoint ``tpu-info`` uses, default
+``localhost:8431``). Crucially it never opens ``/dev/accel*`` itself — the
+TPU runtime lock stays with the workload pod, and the exporter stays a pure
+observer.
+
+Metric names queried (the public libtpu names):
+  - ``tpu.runtime.hbm.memory.usage.bytes``    (per chip)
+  - ``tpu.runtime.hbm.memory.total.bytes``    (per chip)
+  - ``tpu.runtime.tensorcore.dutycycle.percent`` (per chip)
+
+All three are fetched in one poll; each response row carries a device-id
+attribute. Any RPC failure, parse surprise, or shape mismatch raises
+BackendError (total) or is reported via ``HostSample.partial_errors``
+(per-metric) — the collector degrades instead of dying (contrast the
+reference's ``log.Fatalf`` per query, ``main.go:119-137``).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from tpu_pod_exporter.backend import (
+    BackendError,
+    ChipInfo,
+    ChipSample,
+    DeviceBackend,
+    HostSample,
+    IciLinkSample,
+)
+
+log = logging.getLogger("tpu_pod_exporter.backend.libtpu")
+
+DEFAULT_ADDR = "localhost:8431"
+
+HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
+HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
+DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
+# Optional — not all runtime versions export ICI counters; probed once and
+# skipped thereafter if unsupported.
+ICI_TRANSFERRED = "tpu.runtime.ici.transferred.bytes"
+
+GET_METRIC_METHOD = "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
+
+
+def gauge_value(metric) -> float:
+    which = metric.gauge.WhichOneof("value")
+    if which == "as_int":
+        return float(metric.gauge.as_int)
+    if which == "as_double":
+        return float(metric.gauge.as_double)
+    if which == "as_string":
+        try:
+            return float(metric.gauge.as_string)
+        except ValueError:
+            return float("nan")
+    return float("nan")
+
+
+def attr_id(metric) -> str:
+    which = metric.attribute.value.WhichOneof("attr")
+    if which == "int_attr":
+        return str(metric.attribute.value.int_attr)
+    if which == "string_attr":
+        return metric.attribute.value.string_attr
+    return ""
+
+
+def rows_by_device(resp) -> dict[str, float]:
+    """MetricResponse → {device_id_attr: value}."""
+    out: dict[str, float] = {}
+    for m in resp.metric.metrics:
+        out[attr_id(m)] = gauge_value(m)
+    return out
+
+
+class LibtpuMetricsBackend(DeviceBackend):
+    name = "libtpu"
+
+    def __init__(
+        self,
+        addr: str = DEFAULT_ADDR,
+        timeout_s: float = 1.0,
+        device_paths: dict[int, str] | None = None,
+    ) -> None:
+        import grpc
+
+        from tpu_pod_exporter.backend.proto import tpu_metric_service_pb2 as pb
+
+        self._grpc = grpc
+        self._pb = pb
+        self._addr = addr
+        self._timeout_s = timeout_s
+        self._lock = threading.Lock()
+        self._channel = None
+        self._get = None
+        self._ici_supported: bool | None = None  # probed on first sample
+        if device_paths is None:
+            import re
+
+            from tpu_pod_exporter.backend.discovery import list_device_paths
+
+            device_paths = {}
+            for i, p in enumerate(list_device_paths()):
+                m = re.search(r"(\d+)$", p)
+                # Key by the device node's own index (accelN → N), not the
+                # enumeration position — runtime device ids follow the node
+                # numbering even when it is not 0-based contiguous.
+                device_paths[int(m.group(1)) if m else i] = p
+        self._device_paths = device_paths
+
+    def _ensure_channel(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                return
+            self._channel = self._grpc.insecure_channel(
+                self._addr, options=[("grpc.enable_http_proxy", 0)]
+            )
+            self._get = self._channel.unary_unary(
+                GET_METRIC_METHOD,
+                request_serializer=self._pb.MetricRequest.SerializeToString,
+                response_deserializer=self._pb.MetricResponse.FromString,
+            )
+
+    def _query(self, metric_name: str) -> dict[str, float]:
+        self._ensure_channel()
+        resp = self._get(
+            self._pb.MetricRequest(metric_name=metric_name), timeout=self._timeout_s
+        )
+        return rows_by_device(resp)
+
+    def sample(self) -> HostSample:
+        partial: list[str] = []
+        try:
+            usage = self._query(HBM_USAGE)
+            total = self._query(HBM_TOTAL)
+        except self._grpc.RpcError as e:
+            self._reset_channel()
+            raise BackendError(f"libtpu metrics RPC failed: {e.code()}") from e
+        except Exception as e:  # noqa: BLE001
+            self._reset_channel()
+            raise BackendError(f"libtpu metrics query failed: {e}") from e
+
+        try:
+            duty = self._query(DUTY_CYCLE)
+        except Exception as e:  # noqa: BLE001 — HBM without duty is degraded, not down
+            duty = {}
+            partial.append(f"duty-cycle query failed: {e}")
+
+        ici: dict[str, float] = {}
+        if self._ici_supported is not False:
+            try:
+                ici = self._query(ICI_TRANSFERRED)
+                self._ici_supported = True
+            except Exception as e:  # noqa: BLE001
+                if self._ici_supported is None:
+                    # First probe failed → treat as unsupported and stop
+                    # asking (runtimes without the metric return NOT_FOUND).
+                    log.info("ICI counters unsupported by this runtime: %s", e)
+                    self._ici_supported = False
+                else:
+                    # Was supported: a transient failure must not disable
+                    # ICI metrics for the daemon's lifetime.
+                    partial.append(f"ICI query failed: {e}")
+
+        chips: list[ChipSample] = []
+        ordered = sorted(usage, key=_dev_sort_key)
+        # chip_id must be unique per chip: use the runtime's numeric device
+        # ids when ALL ids are numeric (the normal case — they match the GKE
+        # device-plugin ids and the /dev/accel index); otherwise fall back to
+        # enumeration order for every chip so ids can never collide.
+        all_numeric = all(d.isdigit() for d in ordered)
+        for pos, dev_id in enumerate(ordered):
+            idx = int(dev_id) if all_numeric else pos
+            links = ()
+            if dev_id in ici:
+                # Single aggregate counter per chip when per-link detail is
+                # unavailable; labeled link="all".
+                links = (IciLinkSample(link="all", transferred_bytes_total=ici[dev_id]),)
+            chips.append(
+                ChipSample(
+                    info=ChipInfo(
+                        chip_id=idx,
+                        device_path=self._device_paths.get(idx, ""),
+                        device_ids=(dev_id,),
+                    ),
+                    hbm_used_bytes=usage[dev_id],
+                    hbm_total_bytes=total.get(dev_id, 0.0),
+                    tensorcore_duty_cycle_percent=duty.get(dev_id),
+                    ici_links=links,
+                )
+            )
+        return HostSample(chips=tuple(chips), partial_errors=tuple(partial))
+
+    def _reset_channel(self) -> None:
+        with self._lock:
+            if self._channel is not None:
+                try:
+                    self._channel.close()
+                except Exception:  # noqa: BLE001
+                    pass
+            self._channel = None
+            self._get = None
+
+    def close(self) -> None:
+        self._reset_channel()
+
+
+def _dev_sort_key(dev_id: str):
+    try:
+        return (0, int(dev_id))
+    except ValueError:
+        return (1, dev_id)

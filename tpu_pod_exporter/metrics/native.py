@@ -1,0 +1,34 @@
+"""Native exposition rendering via libtpumon (see ``nativelib`` for loading).
+
+The render hot path (thousands of `prefix value\n` lines per poll at 256
+chips × 1 s) runs in C when the shared library is present; callers fall
+back to the pure-Python formatter when ``render_lines`` returns None.
+"""
+
+from __future__ import annotations
+
+import ctypes
+
+from tpu_pod_exporter import nativelib
+
+
+def render_lines(prefixes: list[bytes], values: list[float]) -> bytes | None:
+    """Render `prefix value\\n` lines natively. None → caller falls back."""
+    lib = nativelib.load()
+    if lib is None or not prefixes:
+        return None
+    n = len(prefixes)
+    arr_p = (ctypes.c_char_p * n)(*prefixes)
+    arr_v = (ctypes.c_double * n)(*values)
+    # Worst case ~ prefix + " " + 24-char value + "\n".
+    cap = sum(len(p) for p in prefixes) + 32 * n
+    buf = ctypes.create_string_buffer(cap)
+    written = lib.tpumon_render(arr_p, arr_v, n, buf, cap)
+    if written < 0:
+        return None
+    return buf.raw[:written]
+
+
+def load():
+    """Kept for tests: the shared library handle (or None)."""
+    return nativelib.load()

@@ -81,11 +81,18 @@ def _valid_label_name(name: str) -> bool:
 
 
 def escape_label_value(value: str) -> str:
-    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    # NUL would truncate the line in the native (C-string) render path and
+    # is meaningless in a label; strip it from untrusted input.
+    return (
+        value.replace("\x00", "")
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def escape_help(value: str) -> str:
-    return value.replace("\\", "\\\\").replace("\n", "\\n")
+    return value.replace("\x00", "").replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def format_value(value: float) -> str:
@@ -202,25 +209,50 @@ class Snapshot:
         return dict(fam.samples) if fam is not None else {}
 
     def encode(self) -> bytes:
-        """Prometheus text exposition format (rendered once, then cached)."""
+        """Prometheus text exposition format (rendered once, then cached).
+
+        Sample lines go through the native renderer (libtpumon) when
+        available; header lines and label escaping stay in Python either
+        way. Both paths produce parser-equivalent output.
+        """
         if self._text is not None:
             return self._text
-        out: list[str] = []
+        try:
+            from tpu_pod_exporter.metrics import native
+        except ImportError:  # partial deployment: never let encode() die
+            native = None
+
+        chunks: list[bytes] = []
         for fam in self._families.values():
             spec = fam.spec
-            out.append(f"# HELP {spec.name} {escape_help(spec.help)}\n")
-            out.append(f"# TYPE {spec.name} {spec.type}\n")
+            chunks.append(
+                f"# HELP {spec.name} {escape_help(spec.help)}\n"
+                f"# TYPE {spec.name} {spec.type}\n".encode()
+            )
+            if not fam.samples:
+                continue
+            prefixes: list[bytes] = []
+            values: list[float] = []
             if not spec.label_names:
                 for _, value in fam.samples.items():
-                    out.append(f"{spec.name} {format_value(value)}\n")
-                continue
-            for values, value in fam.samples.items():
-                pairs = ",".join(
-                    f'{ln}="{escape_label_value(lv)}"'
-                    for ln, lv in zip(spec.label_names, values)
+                    prefixes.append(spec.name.encode())
+                    values.append(value)
+            else:
+                for lvs, value in fam.samples.items():
+                    pairs = ",".join(
+                        f'{ln}="{escape_label_value(lv)}"'
+                        for ln, lv in zip(spec.label_names, lvs)
+                    )
+                    prefixes.append(f"{spec.name}{{{pairs}}}".encode())
+                    values.append(value)
+            rendered = native.render_lines(prefixes, values) if native else None
+            if rendered is None:
+                rendered = b"".join(
+                    p + b" " + format_value(v).encode() + b"\n"
+                    for p, v in zip(prefixes, values)
                 )
-                out.append(f"{spec.name}{{{pairs}}} {format_value(value)}\n")
-        self._text = "".join(out).encode("utf-8")
+            chunks.append(rendered)
+        self._text = b"".join(chunks)
         return self._text
 
     def encode_gzip(self) -> bytes:

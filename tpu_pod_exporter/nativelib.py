@@ -1,0 +1,79 @@
+"""Single loader for libtpumon.so — shared by device discovery and the
+exposition renderer.
+
+One CDLL handle, one candidate search (``TPE_NATIVE_LIB`` env override →
+in-repo build → system path), one ABI check. Any load/symbol/ABI surprise
+disables the native path; callers always have a pure-Python fallback, so a
+bad .so can never take the exporter down.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import threading
+from pathlib import Path
+
+log = logging.getLogger("tpu_pod_exporter.nativelib")
+
+ABI_VERSION = 1
+
+_lock = threading.Lock()
+_lib: ctypes.CDLL | None = None
+_tried = False
+
+
+def _candidates():
+    env = os.environ.get("TPE_NATIVE_LIB")
+    if env:
+        yield Path(env)
+    repo_root = Path(__file__).resolve().parent.parent
+    yield repo_root / "native" / "libtpumon.so"
+    yield Path("/usr/local/lib/libtpumon.so")
+
+
+def load() -> ctypes.CDLL | None:
+    global _lib, _tried
+    with _lock:
+        if _tried:
+            return _lib
+        _tried = True
+        for cand in _candidates():
+            if not cand.exists():
+                continue
+            try:
+                lib = ctypes.CDLL(str(cand))
+                lib.tpumon_abi_version.restype = ctypes.c_int
+                if lib.tpumon_abi_version() != ABI_VERSION:
+                    log.warning("%s: ABI version mismatch, ignoring", cand)
+                    continue
+                lib.tpumon_count_devices.restype = ctypes.c_int
+                lib.tpumon_count_devices.argtypes = [ctypes.c_char_p]
+                lib.tpumon_list_devices.restype = ctypes.c_int
+                lib.tpumon_list_devices.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                ]
+                lib.tpumon_render.restype = ctypes.c_long
+                lib.tpumon_render.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_double),
+                    ctypes.c_long,
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                ]
+                _lib = lib
+                log.info("libtpumon loaded from %s", cand)
+                break
+            except (OSError, AttributeError) as e:
+                log.warning("cannot load native lib %s: %s", cand, e)
+        return _lib
+
+
+def reset_for_tests() -> None:
+    global _lib, _tried
+    with _lock:
+        _lib = None
+        _tried = False
