@@ -78,6 +78,28 @@ int scan_devices(const char* root, char* out, long cap) {
     }
   }
 
+  if (count == 0) {
+    // Last resort: sysfs accel class (pods with /sys but no raw /dev nodes).
+    char sys_path[4096];
+    std::snprintf(sys_path, sizeof(sys_path), "%s/sys/class/accel",
+                  root ? root : "/");
+    DIR* s = opendir(sys_path);
+    if (s != nullptr) {
+      struct dirent* e;
+      while ((e = readdir(s)) != nullptr) {
+        if (std::strncmp(e->d_name, "accel", 5) == 0 && is_all_digits(e->d_name + 5)) {
+          ++count;
+          if (out != nullptr) {
+            int n = std::snprintf(out + used, cap > used ? cap - used : 0,
+                                  "/dev/%s\n", e->d_name);
+            if (n > 0 && used + n < cap) used += n;
+          }
+        }
+      }
+      closedir(s);
+    }
+  }
+
   if (out != nullptr && cap > 0) out[used < cap ? used : cap - 1] = '\0';
   return count;
 }

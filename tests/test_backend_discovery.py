@@ -51,6 +51,25 @@ class TestDiscovery:
         assert local_chip_count(root) == 2
         assert len(list_device_paths(root)) == 2
 
+    def test_sysfs_fallback_when_no_dev_nodes(self, tmp_path):
+        accel = tmp_path / "sys" / "class" / "accel"
+        accel.mkdir(parents=True)
+        for i in range(4):
+            (accel / f"accel{i}").mkdir()
+        (accel / "accelctl").mkdir()  # non-numeric ignored
+        root = str(tmp_path)
+        assert local_chip_count(root) == 4
+        assert [c.chip_id for c in discover_chips(root)] == [0, 1, 2, 3]
+        assert discover_chips(root)[0].device_path == "/dev/accel0"
+
+    def test_dev_nodes_beat_sysfs(self, tmp_path):
+        make_dev_tree(tmp_path, ["accel0"])
+        accel = tmp_path / "sys" / "class" / "accel"
+        accel.mkdir(parents=True)
+        for i in range(4):
+            (accel / f"accel{i}").mkdir()
+        assert local_chip_count(str(tmp_path)) == 1
+
     def test_python_and_native_scans_agree(self, tmp_path):
         from tpu_pod_exporter import nativelib
 

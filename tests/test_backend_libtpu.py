@@ -167,6 +167,21 @@ class TestLibtpuBackend:
         assert backend.sample().chips[0].ici_links  # retried, not latched off
         backend.close()
 
+    def test_ici_first_probe_transient_error_not_latched(self, metric_server):
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+        service.set(ICI_TRANSFERRED, [(0, 100)])
+        service.fail_metrics.add(ICI_TRANSFERRED)  # UNAVAILABLE ≠ unsupported
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        assert sample.chips[0].ici_links == ()
+        assert any("ICI" in e for e in sample.partial_errors)
+        service.fail_metrics.clear()
+        assert backend.sample().chips[0].ici_links  # recovered on next poll
+        backend.close()
+
     def test_mixed_device_ids_never_collide(self, metric_server):
         service, addr = metric_server
         resp = pb.MetricResponse()

@@ -1,0 +1,130 @@
+"""Pod-churn stress (SURVEY.md §4.4, baseline config 5).
+
+Attribution flips at high rate while a scraper hammers /metrics at ~1 s-like
+cadence. Invariants under churn:
+- every scrape parses and is internally consistent (no half-applied polls),
+- no stale series: the set of pods in any scrape is a subset of pods that
+  were ever assigned, and dead pods disappear within one poll,
+- counters never regress,
+- series count stays bounded (no leak across reassignments).
+"""
+
+import threading
+import time
+import urllib.request
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpu_pod_exporter.app import ExporterApp
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+from tpu_pod_exporter.config import ExporterConfig
+
+CHIPS = 8
+
+
+@pytest.fixture
+def churn_app():
+    backend = FakeBackend(
+        chips=CHIPS,
+        script=FakeChipScript(
+            hbm_total_bytes=16 * 1024**3,
+            hbm_used_bytes=1024**3,
+            ici_link_count=4,
+            ici_bytes_per_step=10_000.0,
+        ),
+    )
+    attr = FakeAttribution()
+    cfg = ExporterConfig(port=0, host="127.0.0.1", interval_s=0.01, accelerator="v5e-8")
+    app = ExporterApp(cfg, backend=backend, attribution=attr)
+    app.start()
+    yield app, attr
+    app.stop()
+
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+class TestChurn:
+    def test_churn_invariants(self, churn_app):
+        app, attr = churn_app
+        stop = threading.Event()
+        generation = [0]
+
+        def churner():
+            g = 0
+            while not stop.is_set():
+                g += 1
+                generation[0] = g
+                # alternate: two pods splitting the chips / one pod / none
+                phase = g % 3
+                if phase == 0:
+                    attr.set_allocations([])
+                elif phase == 1:
+                    attr.set_allocations(
+                        [simple_allocation(f"pod-a-{g}", [str(i) for i in range(4)]),
+                         simple_allocation(f"pod-b-{g}", [str(i) for i in range(4, 8)])]
+                    )
+                else:
+                    attr.set_allocations(
+                        [simple_allocation(f"solo-{g}", [str(i) for i in range(CHIPS)])]
+                    )
+                time.sleep(0.003)
+
+        t = threading.Thread(target=churner, daemon=True)
+        t.start()
+        try:
+            prev_polls = 0.0
+            for _ in range(60):
+                fams = {
+                    f.name: f for f in text_string_to_metric_families(scrape(app.port))
+                }
+                used = fams["tpu_hbm_used_bytes"].samples
+                # exactly one series per chip, always
+                assert len(used) == CHIPS
+                chip_ids = sorted(int(s.labels["chip_id"]) for s in used)
+                assert chip_ids == list(range(CHIPS))
+                # attribution is all-or-nothing per snapshot: any named pods
+                # belong to a single churn generation's naming scheme
+                pods = {s.labels["pod"] for s in used if s.labels["pod"]}
+                gens = {p.rsplit("-", 1)[-1] for p in pods}
+                assert len(gens) <= 1, f"mixed generations in one scrape: {pods}"
+                # monotonic self-counter
+                polls = fams["tpu_exporter_polls"].samples[0].value
+                assert polls >= prev_polls
+                prev_polls = polls
+                time.sleep(0.005)
+        finally:
+            stop.set()
+            t.join(timeout=2)
+
+    def test_series_count_bounded_under_churn(self, churn_app):
+        app, attr = churn_app
+        counts = []
+        for g in range(50):
+            attr.set_allocations(
+                [simple_allocation(f"pod-{g}", [str(i) for i in range(CHIPS)])]
+            )
+            time.sleep(0.01)
+            fams = {f.name: f for f in text_string_to_metric_families(scrape(app.port))}
+            counts.append(sum(len(f.samples) for f in fams.values()))
+        # churned pods must not accumulate series: counts stay flat
+        assert max(counts) - min(counts) <= 2, counts
+
+    def test_counters_never_regress_across_reassignment(self, churn_app):
+        app, attr = churn_app
+        last = {}
+        for g in range(20):
+            attr.set_allocations(
+                [simple_allocation(f"p{g}", [str(i) for i in range(CHIPS)])]
+            )
+            time.sleep(0.01)
+            fams = {f.name: f for f in text_string_to_metric_families(scrape(app.port))}
+            for s in fams["tpu_ici_transferred_bytes"].samples:
+                key = (s.labels["chip_id"], s.labels["link"], s.labels["pod"])
+                if key in last:
+                    assert s.value >= last[key]
+                last[key] = s.value

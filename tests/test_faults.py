@@ -1,0 +1,127 @@
+"""Fault injection at the app level (SURVEY.md §4.5): backends erroring and
+timing out mid-poll must degrade the exporter, never kill it — the inversion
+of the reference's log.Fatalf-in-loop behavior (main.go:119-137)."""
+
+import time
+import urllib.request
+
+import pytest
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpu_pod_exporter.app import ExporterApp
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+from tpu_pod_exporter.backend import BackendError
+from tpu_pod_exporter.backend.fake import FakeBackend
+from tpu_pod_exporter.config import ExporterConfig
+
+
+def scrape(port):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}/metrics", timeout=5) as r:
+        return r.read().decode()
+
+
+def fams_of(port):
+    return {f.name: f for f in text_string_to_metric_families(scrape(port))}
+
+
+@pytest.fixture
+def app_with_fakes():
+    backend = FakeBackend(chips=2)
+    attr = FakeAttribution([simple_allocation("p", ["0", "1"])])
+    cfg = ExporterConfig(port=0, host="127.0.0.1", interval_s=0.02)
+    app = ExporterApp(cfg, backend=backend, attribution=attr)
+    app.start()
+    yield app, backend, attr
+    app.stop()
+
+
+def wait_polls(port, n, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fams_of(port)["tpu_exporter_polls"].samples[0].value >= n:
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"never reached {n} polls")
+
+
+class TestFaultInjection:
+    def test_repeated_backend_failures_then_recovery(self, app_with_fakes):
+        app, backend, _ = app_with_fakes
+        wait_polls(app.port, 3)
+        backend.fail_next(10)
+        deadline = time.monotonic() + 5
+        saw_down = False
+        while time.monotonic() < deadline:
+            fams = fams_of(app.port)
+            if fams["tpu_exporter_up"].samples[0].value == 0:
+                saw_down = True
+                break
+            time.sleep(0.01)
+        assert saw_down, "up never dropped during failure burst"
+        # exporter keeps serving during the outage
+        assert scrape(app.port)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            fams = fams_of(app.port)
+            if fams["tpu_exporter_up"].samples[0].value == 1:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("never recovered")
+        errs = {
+            s.labels["source"]: s.value
+            for s in fams["tpu_exporter_poll_errors"].samples
+        }
+        assert errs.get("device_read", 0) >= 10
+
+    def test_slow_backend_does_not_block_scrapes(self, app_with_fakes):
+        app, backend, _ = app_with_fakes
+
+        class SlowSample:
+            def __init__(self, inner):
+                self.inner = inner
+
+            def __call__(self):
+                time.sleep(0.5)
+                return self.inner()
+
+        backend.sample = SlowSample(backend.sample)  # type: ignore[method-assign]
+        t0 = time.monotonic()
+        scrape(app.port)
+        assert time.monotonic() - t0 < 0.3, "scrape blocked behind slow poll"
+
+    def test_attribution_flaps(self, app_with_fakes):
+        app, _, attr = app_with_fakes
+        for _ in range(5):
+            attr.fail_next(2)
+            time.sleep(0.05)
+        fams = fams_of(app.port)
+        assert fams["tpu_exporter_up"].samples[0].value == 1
+        used = fams["tpu_hbm_used_bytes"].samples
+        # last-good attribution still applied through the flaps
+        assert all(s.labels["pod"] == "p" for s in used)
+
+    def test_poison_backend_exception_type(self, app_with_fakes):
+        """Non-BackendError exceptions are still contained by the loop."""
+        app, backend, _ = app_with_fakes
+
+        calls = {"n": 0}
+        real = backend.sample
+
+        def poison():
+            calls["n"] += 1
+            if calls["n"] % 2:
+                raise ValueError("not a BackendError")
+            return real()
+
+        backend.sample = poison  # type: ignore[method-assign]
+        time.sleep(0.2)
+        fams = fams_of(app.port)
+        # exporter alive, errors counted, and good polls still publish
+        assert fams["tpu_exporter_polls"].samples[0].value > 0
+        errs = {
+            s.labels["source"]: s.value
+            for s in fams["tpu_exporter_poll_errors"].samples
+        }
+        assert errs.get("device_read", 0) >= 1
+        assert scrape(app.port)

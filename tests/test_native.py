@@ -18,10 +18,14 @@ SO = REPO / "native" / "libtpumon.so"
 
 @pytest.fixture(scope="module")
 def built_lib():
+    from tpu_pod_exporter import nativelib
+
     if not SO.exists():
         if shutil.which("g++") is None:
             pytest.skip("no libtpumon.so and no g++ to build it")
         subprocess.run(["make"], cwd=REPO / "native", check=True, capture_output=True)
+        # earlier tests may have cached a failed load from before the build
+        nativelib.reset_for_tests()
     lib = native.load()
     if lib is None:
         pytest.skip("native lib not loadable")

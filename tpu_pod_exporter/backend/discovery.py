@@ -41,7 +41,19 @@ def _scan(root: str) -> list[str]:
                 vfio.append((int(name), f"/dev/vfio/{name}"))
     except OSError:
         pass
-    return [p for _, p in sorted(vfio)]
+    if vfio:
+        return [p for _, p in sorted(vfio)]
+    # Last resort: sysfs. Pods sometimes get /sys mounted but not raw /dev
+    # nodes; the accel class still names the chips (SURVEY.md §2.7 commits
+    # to sysfs discovery).
+    sysfs: list[tuple[int, str]] = []
+    try:
+        for name in os.listdir(os.path.join(root, "sys", "class", "accel")):
+            if name.startswith("accel") and _NUM.match(name[5:]):
+                sysfs.append((int(name[5:]), f"/dev/{name}"))
+    except OSError:
+        pass
+    return [p for _, p in sorted(sysfs)]
 
 
 def list_device_paths(root: str = "/") -> list[str]:

@@ -106,11 +106,15 @@ class LibtpuMetricsBackend(DeviceBackend):
 
             device_paths = {}
             for i, p in enumerate(list_device_paths()):
-                m = re.search(r"(\d+)$", p)
-                # Key by the device node's own index (accelN → N), not the
-                # enumeration position — runtime device ids follow the node
-                # numbering even when it is not 0-based contiguous.
-                device_paths[int(m.group(1)) if m else i] = p
+                if "/vfio/" in p:
+                    # vfio group numbers are kernel-assigned and unrelated to
+                    # runtime device ids — key positionally.
+                    device_paths[i] = p
+                else:
+                    # accelN → N: runtime device ids follow the node
+                    # numbering even when it is not 0-based contiguous.
+                    m = re.search(r"(\d+)$", p)
+                    device_paths[int(m.group(1)) if m else i] = p
         self._device_paths = device_paths
 
     def _ensure_channel(self) -> None:
@@ -157,14 +161,20 @@ class LibtpuMetricsBackend(DeviceBackend):
                 ici = self._query(ICI_TRANSFERRED)
                 self._ici_supported = True
             except Exception as e:  # noqa: BLE001
-                if self._ici_supported is None:
-                    # First probe failed → treat as unsupported and stop
-                    # asking (runtimes without the metric return NOT_FOUND).
+                code = getattr(e, "code", lambda: None)()
+                unsupported = code in (
+                    self._grpc.StatusCode.NOT_FOUND,
+                    self._grpc.StatusCode.UNIMPLEMENTED,
+                    self._grpc.StatusCode.INVALID_ARGUMENT,
+                )
+                if self._ici_supported is None and unsupported:
+                    # The runtime affirmatively does not export this metric:
+                    # stop asking.
                     log.info("ICI counters unsupported by this runtime: %s", e)
                     self._ici_supported = False
                 else:
-                    # Was supported: a transient failure must not disable
-                    # ICI metrics for the daemon's lifetime.
+                    # Transient (timeout/unavailable) — whether on the first
+                    # probe or after success, keep retrying and surface it.
                     partial.append(f"ICI query failed: {e}")
 
         chips: list[ChipSample] = []

@@ -1,0 +1,20 @@
+"""Synthetic TPU workloads for telemetry validation and benchmarking.
+
+The reference has no analog (it observes whatever happens to be running).
+For a metrics exporter, a controllable load source is the missing test
+instrument: drive the MXU (duty cycle), fill HBM (memory gauges), and push
+ICI traffic (link counters) with known shapes, then assert the exporter
+reports them. TPU-first by construction: bf16 matmuls sized for the
+systolic array, ``lax.scan`` instead of Python loops, static shapes, and
+multi-chip variants expressed as shardings over a ``jax.sharding.Mesh`` so
+XLA inserts the collectives.
+"""
+
+from tpu_pod_exporter.loadgen.workload import (
+    burn_step,
+    flagship,
+    hbm_fill,
+    init_params,
+)
+
+__all__ = ["burn_step", "flagship", "hbm_fill", "init_params"]

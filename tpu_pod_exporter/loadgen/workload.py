@@ -1,0 +1,99 @@
+"""Single-chip synthetic workloads.
+
+``flagship()`` is the canonical jittable forward step: a depth-stacked bf16
+matmul chain driven by ``lax.scan``. Everything the MXU likes — large square
+matmuls, bf16 inputs with f32 accumulation, one fused tanh per layer, no
+data-dependent Python control flow — and nothing it doesn't.
+"""
+
+from __future__ import annotations
+
+import functools
+
+
+def _jax():
+    import jax
+    import jax.numpy as jnp
+
+    return jax, jnp
+
+
+def init_params(width: int = 512, depth: int = 8, seed: int = 0):
+    """Stacked layer weights (depth, width, width) in bf16.
+
+    Stacking + scan compiles one layer body reused `depth` times instead of
+    unrolling `depth` HLOs — smaller programs, same MXU throughput.
+    """
+    jax, jnp = _jax()
+    key = jax.random.PRNGKey(seed)
+    scale = (2.0 / width) ** 0.5
+    w = jax.random.normal(key, (depth, width, width), dtype=jnp.float32) * scale
+    return {"layers": w.astype(jnp.bfloat16)}
+
+
+def forward(params, x):
+    """x: (batch, width) bf16 → (batch, width) bf16."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def layer(h, w):
+        # f32 accumulation on the MXU, cast back to keep HBM traffic in bf16.
+        y = jnp.dot(h, w, preferred_element_type=jnp.float32)
+        return jnp.tanh(y).astype(jnp.bfloat16), None
+
+    out, _ = lax.scan(layer, x, params["layers"])
+    return out
+
+
+def loss_fn(params, x, y):
+    import jax.numpy as jnp
+
+    pred = forward(params, x).astype(jnp.float32)
+    return jnp.mean((pred - y.astype(jnp.float32)) ** 2)
+
+
+def flagship(width: int = 512, depth: int = 8, batch: int = 256):
+    """(jittable forward fn, example_args) — the compile-check entry point."""
+    jax, jnp = _jax()
+    params = init_params(width=width, depth=depth)
+    x = jnp.ones((batch, width), dtype=jnp.bfloat16)
+    return jax.jit(forward), (params, x)
+
+
+@functools.lru_cache(maxsize=None)
+def _burn_fn(width: int, depth: int, iters: int):
+    jax, jnp = _jax()
+    from jax import lax
+
+    def burn(params, x):
+        def body(h, _):
+            h = forward(params, h)
+            return h, None
+
+        out, _ = lax.scan(body, x, None, length=iters)
+        return out
+
+    return jax.jit(burn)
+
+
+def burn_step(params, x, iters: int = 10):
+    """Run `iters` forward passes on-device per call — a duty-cycle dial:
+    more iters per wall-second → higher TensorCore utilization."""
+    width = x.shape[-1]
+    depth = params["layers"].shape[0]
+    return _burn_fn(width, depth, iters)(params, x)
+
+
+def hbm_fill(n_bytes: int, device=None):
+    """Allocate ~n_bytes on device (bf16 zeros) and return the live buffer.
+
+    Holding the returned array keeps the HBM in use — the instrument for
+    exercising tpu_hbm_used_bytes end-to-end on real hardware.
+    """
+    jax, jnp = _jax()
+    n = max(n_bytes // 2, 1)  # bf16 = 2 bytes
+    arr = jnp.zeros((n,), dtype=jnp.bfloat16)
+    if device is not None:
+        arr = jax.device_put(arr, device)
+    return arr.block_until_ready()
