@@ -1,0 +1,19 @@
+# Developer entry points.
+.PHONY: test native proto bench clean
+
+test:
+	python -m pytest tests/ -q
+
+native:
+	$(MAKE) -C native
+
+proto:
+	cd tpu_pod_exporter/attribution/proto && protoc --python_out=. podresources.proto
+	cd tpu_pod_exporter/backend/proto && protoc --python_out=. tpu_metric_service.proto
+
+bench: native
+	python bench.py
+
+clean:
+	$(MAKE) -C native clean
+	find . -name __pycache__ -type d -exec rm -rf {} +

@@ -4,8 +4,14 @@ None/raise off-TPU; the backend must degrade to zeroed HBM, never crash."""
 
 import pytest
 
+from tests.conftest import require_jax
 from tpu_pod_exporter.backend import BackendError
 from tpu_pod_exporter.backend.jaxdev import JaxDeviceBackend
+
+
+@pytest.fixture(autouse=True)
+def _needs_jax():
+    require_jax()
 
 
 class TestJaxDeviceBackend:

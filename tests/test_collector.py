@@ -230,6 +230,23 @@ class TestIciRates:
         assert snap.value("tpu_ici_transferred_bytes_total", labels) == 1000.0
         assert snap.value("tpu_ici_link_bandwidth_bytes_per_second", labels) == 250.0
 
+    def test_counter_state_survives_failed_poll(self, store):
+        """A transient device-read failure must not wipe ICI counter state —
+        otherwise the exported counter regresses to the raw value on
+        recovery (spurious rate() spike in Prometheus)."""
+        script = FakeChipScript(ici_link_count=1, ici_bytes_per_step=100.0)
+        backend = FakeBackend(chips=1, script=script)
+        c = make_collector(backend, FakeAttribution(), store)
+        labels = {**chip_labels(0), "link": "0"}
+        c.poll_once()  # total=100 (step 0 → (0+1)*100)
+        c.poll_once()  # total=200
+        assert store.current().value("tpu_ici_transferred_bytes_total", labels) == 200.0
+        backend.fail_next(1)
+        c.poll_once()  # failed poll: no ICI series this snapshot
+        assert store.current().value("tpu_ici_transferred_bytes_total", labels) is None
+        c.poll_once()  # recovery: counter resumes monotonically, no regression
+        assert store.current().value("tpu_ici_transferred_bytes_total", labels) >= 200.0
+
     def test_rate_survives_pod_relabel(self, store):
         # Chip moves pod-a -> pod-b between polls; counter state is keyed by
         # full label set, so the new series starts fresh but stays monotonic.

@@ -97,6 +97,21 @@ class TestConfig2SingleHostOnePod:
         assert len(pod_count) == 1 and pod_count[0].value == 4
 
 
+class TestDebugVars:
+    def test_debug_vars_endpoint(self, app_factory):
+        import json
+
+        app = app_factory(FakeBackend(chips=2), FakeAttribution())
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{app.port}/debug/vars", timeout=5
+        ) as r:
+            doc = json.load(r)
+        assert doc["last_poll"]["ok"] is True
+        assert doc["config"]["backend"] == "fake"
+        assert doc["series"] > 0
+        assert doc["snapshot_age_s"] >= 0
+
+
 class TestLoopCadence:
     def test_background_polling_advances(self, app_factory):
         backend = FakeBackend(chips=1)

@@ -4,11 +4,19 @@ multi-chip path must compile and run with zero TPU hardware)."""
 import numpy as np
 import pytest
 
-jax = pytest.importorskip("jax")
+from tests.conftest import require_jax
+
+
+@pytest.fixture(autouse=True)
+def _needs_jax():
+    require_jax()
 
 
 @pytest.fixture(scope="module")
 def cpu_devices():
+    require_jax()
+    import jax
+
     devs = jax.devices("cpu")
     if len(devs) < 8:
         pytest.skip("need 8 virtual CPU devices (conftest sets XLA_FLAGS)")

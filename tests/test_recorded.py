@@ -1,0 +1,119 @@
+"""Record/replay backend tests (SURVEY.md §7: the third backend seam)."""
+
+import json
+
+import pytest
+
+from tpu_pod_exporter.backend import BackendError
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+from tpu_pod_exporter.backend.recorded import (
+    RecordedBackend,
+    RecordingBackend,
+    sample_from_dict,
+    sample_to_dict,
+)
+
+
+class TestRoundtrip:
+    def test_sample_dict_roundtrip(self):
+        backend = FakeBackend(
+            chips=2,
+            script=FakeChipScript(
+                hbm_total_bytes=1000, hbm_used_bytes=100,
+                duty_cycle_percent=50.0, ici_link_count=2, ici_bytes_per_step=10,
+            ),
+        )
+        original = backend.sample()
+        restored = sample_from_dict(sample_to_dict(original))
+        assert restored == original
+
+    def test_none_duty_preserved(self):
+        backend = FakeBackend(chips=1, script=FakeChipScript(duty_cycle_percent=None))
+        restored = sample_from_dict(sample_to_dict(backend.sample()))
+        assert restored.chips[0].tensorcore_duty_cycle_percent is None
+
+
+class TestRecordReplay:
+    def test_record_then_replay(self, tmp_path):
+        path = str(tmp_path / "trace.jsonl")
+        inner = FakeBackend(
+            chips=2,
+            script=FakeChipScript(hbm_used_bytes=lambda step: float(step * 100)),
+        )
+        rec = RecordingBackend(inner, path)
+        originals = [rec.sample() for _ in range(3)]
+        rec.close()
+        assert inner.closed
+
+        replay = RecordedBackend(path, loop=True)
+        assert len(replay) == 3
+        for orig in originals:
+            assert replay.sample() == orig
+        # loops back to the start
+        assert replay.sample() == originals[0]
+
+    def test_hold_last_when_not_looping(self, tmp_path):
+        path = str(tmp_path / "t.jsonl")
+        rec = RecordingBackend(FakeBackend(chips=1), path)
+        rec.sample()
+        rec.close()
+        replay = RecordedBackend(path, loop=False)
+        first = replay.sample()
+        assert replay.sample() == first
+
+    def test_empty_recording_raises(self, tmp_path):
+        p = tmp_path / "empty.jsonl"
+        p.write_text("")
+        with pytest.raises(BackendError):
+            RecordedBackend(str(p))
+
+    def test_corrupt_line_raises_with_location(self, tmp_path):
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"chips": []}\n{broken\n')
+        with pytest.raises(BackendError, match=":2"):
+            RecordedBackend(str(p))
+
+    def test_missing_file_raises(self, tmp_path):
+        with pytest.raises(BackendError):
+            RecordedBackend(str(tmp_path / "nope.jsonl"))
+
+    def test_recording_passes_through_errors(self, tmp_path):
+        inner = FakeBackend(chips=1)
+        inner.fail_next(1)
+        rec = RecordingBackend(inner, str(tmp_path / "t.jsonl"))
+        with pytest.raises(BackendError):
+            rec.sample()
+        rec.sample()  # recovers; only good samples recorded
+        rec.close()
+        lines = (tmp_path / "t.jsonl").read_text().strip().splitlines()
+        assert len(lines) == 1
+
+
+class TestAppIntegration:
+    def test_cli_config_wires_recorded_backend(self, tmp_path):
+        from tpu_pod_exporter.app import build_backend
+        from tpu_pod_exporter.config import ExporterConfig
+
+        path = str(tmp_path / "trace.jsonl")
+        rec = RecordingBackend(FakeBackend(chips=2), path)
+        rec.sample()
+        rec.close()
+        cfg = ExporterConfig(backend="recorded", recording_path=path)
+        backend = build_backend(cfg)
+        assert backend.name == "recorded"
+        assert len(backend.sample().chips) == 2
+
+    def test_record_to_wraps_backend(self, tmp_path):
+        from tpu_pod_exporter.app import ExporterApp
+        from tpu_pod_exporter.attribution.fake import FakeAttribution
+        from tpu_pod_exporter.config import ExporterConfig
+
+        path = str(tmp_path / "out.jsonl")
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", interval_s=5.0, record_to=path
+        )
+        app = ExporterApp(cfg, backend=FakeBackend(chips=1), attribution=FakeAttribution())
+        app.start()  # first poll records one sample
+        app.stop()
+        lines = [json.loads(l) for l in open(path)]
+        assert lines and lines[0]["chips"][0]["chip_id"] == 0

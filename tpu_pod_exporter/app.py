@@ -11,6 +11,7 @@ import logging
 import os
 import signal
 import threading
+import time
 
 from tpu_pod_exporter.attribution import AttributionProvider
 from tpu_pod_exporter.attribution.fake import FakeAttribution
@@ -48,7 +49,19 @@ def build_backend(cfg: ExporterConfig) -> DeviceBackend:
     return _build_named_backend(choice, cfg)
 
 
+def _maybe_record(backend: DeviceBackend, cfg: ExporterConfig) -> DeviceBackend:
+    if cfg.record_to:
+        from tpu_pod_exporter.backend.recorded import RecordingBackend
+
+        return RecordingBackend(backend, cfg.record_to)
+    return backend
+
+
 def _build_named_backend(choice: str, cfg: ExporterConfig) -> DeviceBackend:
+    if choice == "recorded":
+        from tpu_pod_exporter.backend.recorded import RecordedBackend
+
+        return RecordedBackend(cfg.recording_path)
     if choice == "fake":
         return FakeBackend(chips=cfg.fake_chips)
     if choice == "jax":
@@ -110,7 +123,9 @@ class ExporterApp:
     ) -> None:
         self.cfg = cfg
         self.store = SnapshotStore()
-        self.backend = backend if backend is not None else build_backend(cfg)
+        self.backend = _maybe_record(
+            backend if backend is not None else build_backend(cfg), cfg
+        )
         self.attribution = (
             attribution if attribution is not None else build_attribution(cfg)
         )
@@ -129,7 +144,35 @@ class ExporterApp:
             attribution_max_stale_s=cfg.attribution_max_stale_s,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
-        self.server = MetricsServer(self.store, host=cfg.host, port=cfg.port)
+        self.server = MetricsServer(
+            self.store, host=cfg.host, port=cfg.port, debug_vars=self._debug_vars
+        )
+
+    def _debug_vars(self) -> dict:
+        """Introspection payload for /debug/vars (SURVEY.md §5: per-phase
+        tracing beyond what fits in Prometheus gauges)."""
+        stats = self.collector.last_stats
+        snap = self.store.current()  # bind once: series + age must agree
+        return {
+            "config": {
+                "interval_s": self.cfg.interval_s,
+                "backend": getattr(self.backend, "name", "?"),
+                "attribution": getattr(self.attribution, "name", "?"),
+                "resource_name": self.cfg.resource_name,
+            },
+            "last_poll": {
+                "ok": stats.ok,
+                "errors": list(stats.errors),
+                "device_read_s": stats.device_read_s,
+                "attribution_s": stats.attribution_s,
+                "join_s": stats.join_s,
+                "publish_s": stats.publish_s,
+                "total_s": stats.total_s,
+            },
+            "loop_overruns": self.loop.overruns,
+            "series": snap.series_count,
+            "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
+        }
 
     @property
     def port(self) -> int:

@@ -1,0 +1,141 @@
+"""Record/replay device backend — the third seam (SURVEY.md §7: "real
+(libtpu), fake (tests), and a recorded mode for benchmarks").
+
+``RecordingBackend`` wraps any backend and appends every HostSample to a
+JSONL file; ``RecordedBackend`` replays such a file deterministically (loop
+or hold-last). This turns one session against real hardware into a
+repeatable benchmark/regression input with genuine value distributions —
+something the reference has no equivalent for.
+
+JSONL schema (one poll per line):
+    {"chips": [{"chip_id": 0, "device_path": "...", "device_ids": ["0"],
+                "hbm_used": N, "hbm_total": N, "duty": N|null,
+                "ici": {"0": N, ...}}, ...],
+     "partial_errors": ["..."]}
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import IO
+
+from tpu_pod_exporter.backend import (
+    BackendError,
+    ChipInfo,
+    ChipSample,
+    DeviceBackend,
+    HostSample,
+    IciLinkSample,
+)
+
+
+def sample_to_dict(sample: HostSample) -> dict:
+    return {
+        "chips": [
+            {
+                "chip_id": c.info.chip_id,
+                "device_path": c.info.device_path,
+                "device_ids": list(c.info.device_ids),
+                "hbm_used": c.hbm_used_bytes,
+                "hbm_total": c.hbm_total_bytes,
+                "duty": c.tensorcore_duty_cycle_percent,
+                "ici": {l.link: l.transferred_bytes_total for l in c.ici_links},
+            }
+            for c in sample.chips
+        ],
+        "partial_errors": list(sample.partial_errors),
+    }
+
+
+def sample_from_dict(doc: dict) -> HostSample:
+    chips = []
+    for c in doc.get("chips", []):
+        chips.append(
+            ChipSample(
+                info=ChipInfo(
+                    chip_id=int(c["chip_id"]),
+                    device_path=c.get("device_path", ""),
+                    device_ids=tuple(c.get("device_ids") or [str(c["chip_id"])]),
+                ),
+                hbm_used_bytes=float(c["hbm_used"]),
+                hbm_total_bytes=float(c["hbm_total"]),
+                tensorcore_duty_cycle_percent=(
+                    None if c.get("duty") is None else float(c["duty"])
+                ),
+                ici_links=tuple(
+                    IciLinkSample(link=str(k), transferred_bytes_total=float(v))
+                    for k, v in sorted((c.get("ici") or {}).items())
+                ),
+            )
+        )
+    return HostSample(
+        chips=tuple(chips),
+        partial_errors=tuple(doc.get("partial_errors", [])),
+    )
+
+
+class RecordingBackend(DeviceBackend):
+    """Pass-through wrapper that records every sample to a JSONL stream."""
+
+    name = "recording"
+
+    def __init__(self, inner: DeviceBackend, sink: str | IO[str]) -> None:
+        self._inner = inner
+        self._own_file = isinstance(sink, str)
+        self._sink: IO[str] = open(sink, "a") if isinstance(sink, str) else sink
+        self._lock = threading.Lock()
+        self.name = f"recording({inner.name})"
+
+    def sample(self) -> HostSample:
+        sample = self._inner.sample()  # BackendError propagates untouched
+        line = json.dumps(sample_to_dict(sample))
+        with self._lock:
+            self._sink.write(line + "\n")
+            self._sink.flush()
+        return sample
+
+    def close(self) -> None:
+        self._inner.close()
+        if self._own_file:
+            self._sink.close()
+
+
+class RecordedBackend(DeviceBackend):
+    """Deterministic replay of a recorded JSONL trace."""
+
+    name = "recorded"
+
+    def __init__(self, path: str, loop: bool = True) -> None:
+        self._samples: list[HostSample] = []
+        try:
+            with open(path) as f:
+                for ln, line in enumerate(f, 1):
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        self._samples.append(sample_from_dict(json.loads(line)))
+                    except (json.JSONDecodeError, KeyError, ValueError) as e:
+                        raise BackendError(f"{path}:{ln}: bad record: {e}") from e
+        except OSError as e:
+            raise BackendError(f"cannot read recording {path}: {e}") from e
+        if not self._samples:
+            raise BackendError(f"recording {path} is empty")
+        self._loop = loop
+        self._i = 0
+        self._lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self._samples)
+
+    def sample(self) -> HostSample:
+        with self._lock:
+            if self._i >= len(self._samples):
+                if self._loop:
+                    self._i = 0
+                else:
+                    return self._samples[-1]  # hold last frame
+            s = self._samples[self._i]
+            self._i += 1
+        return s

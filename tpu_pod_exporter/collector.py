@@ -38,6 +38,7 @@ from tpu_pod_exporter.attribution import (
 from tpu_pod_exporter.backend import BackendError, DeviceBackend, HostSample
 from tpu_pod_exporter.metrics import CounterStore, Snapshot, SnapshotBuilder, SnapshotStore
 from tpu_pod_exporter.metrics import schema
+from tpu_pod_exporter.metrics.registry import PrefixCache
 from tpu_pod_exporter.topology import HostTopology
 from tpu_pod_exporter.version import __version__
 
@@ -79,6 +80,13 @@ class Collector:
         self._wallclock = wallclock
 
         self._counters = CounterStore()
+        self._prefix_cache = PrefixCache()
+        # Topology labels are fixed for the process lifetime; pre-order them
+        # once for the tuple fast path (CHIP_LABELS[2:6]).
+        t = self._topology.labels()
+        self._topo_tuple = (
+            t["accelerator"], t["slice_name"], t["host"], t["worker_id"],
+        )
         self._last_attr: AttributionSnapshot | None = None
         self._last_attr_at: float = 0.0
         # previous folded ICI totals + read time, for bandwidth rates
@@ -155,8 +163,7 @@ class Collector:
     # --------------------------------------------------------------- publish
 
     def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float) -> None:
-        b = SnapshotBuilder()
-        topo = self._topology.labels()
+        b = SnapshotBuilder(prefix_cache=self._prefix_cache)
 
         # Declare the full schema up front so families are present (and typed)
         # even when sample-less — scrapers see a stable surface from poll #1.
@@ -171,49 +178,42 @@ class Collector:
             dt = None
             if self._prev_ici_at is not None:
                 dt = max(now_mono - self._prev_ici_at, 1e-9)
+            ici_name = schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name
+            observe_total = self._counters.observe_total
             for chip in host_sample.chips:
                 owner = None
                 for did in chip.info.device_ids:
                     owner = device_owner.get(did)
                     if owner is not None:
                         break
-                chip_labels = {
-                    "chip_id": str(chip.info.chip_id),
-                    "device_path": chip.info.device_path,
-                    **topo,
-                    "pod": owner.pod if owner else "",
-                    "namespace": owner.namespace if owner else "",
-                    "container": owner.container if owner else "",
-                }
-                b.add(schema.TPU_HBM_USED_BYTES, chip.hbm_used_bytes, chip_labels)
-                b.add(schema.TPU_HBM_TOTAL_BYTES, chip.hbm_total_bytes, chip_labels)
+                # Tuple fast path, pre-ordered to CHIP_LABELS.
+                chip_tuple = (
+                    str(chip.info.chip_id),
+                    chip.info.device_path,
+                    *self._topo_tuple,
+                    owner.pod if owner else "",
+                    owner.namespace if owner else "",
+                    owner.container if owner else "",
+                )
+                b.add(schema.TPU_HBM_USED_BYTES, chip.hbm_used_bytes, chip_tuple)
+                b.add(schema.TPU_HBM_TOTAL_BYTES, chip.hbm_total_bytes, chip_tuple)
                 b.add(
                     schema.TPU_HBM_USED_PERCENT,
                     schema.hbm_used_percent(chip.hbm_used_bytes, chip.hbm_total_bytes),
-                    chip_labels,
+                    chip_tuple,
                 )
                 if chip.tensorcore_duty_cycle_percent is not None:
                     b.add(
                         schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
                         chip.tensorcore_duty_cycle_percent,
-                        chip_labels,
+                        chip_tuple,
                     )
 
                 for link in chip.ici_links:
-                    ici_labels = {**chip_labels, "link": link.link}
-                    lv = tuple(
-                        ici_labels[ln]
-                        for ln in schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.label_names
-                    )
-                    total = self._counters.observe_total(
-                        schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name,
-                        lv,
-                        link.transferred_bytes_total,
-                    )
-                    live_counter_keys.add(
-                        (schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name, lv)
-                    )
-                    b.add(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL, total, ici_labels)
+                    lv = chip_tuple + (link.link,)  # ICI_LABELS ordering
+                    total = observe_total(ici_name, lv, link.transferred_bytes_total)
+                    live_counter_keys.add((ici_name, lv))
+                    b.add(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL, total, lv)
 
                     rate_key = (str(chip.info.chip_id), link.link)
                     ici_now[rate_key] = total
@@ -222,13 +222,11 @@ class Collector:
                         b.add(
                             schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
                             max(total - prev, 0.0) / dt,
-                            ici_labels,
+                            lv,
                         )
 
                 if owner is not None:
-                    rk = (owner.pod, owner.namespace) + tuple(
-                        topo[k] for k in ("accelerator", "slice_name", "host", "worker_id")
-                    )
+                    rk = (owner.pod, owner.namespace) + self._topo_tuple
                     agg = pod_rollup.setdefault(rk, [0.0, 0.0])
                     agg[0] += 1.0
                     agg[1] += chip.hbm_used_bytes
@@ -237,9 +235,8 @@ class Collector:
             self._prev_ici_at = now_mono
 
         for rk, (nchips, hbm) in pod_rollup.items():
-            labels = dict(zip(schema.POD_LABELS, rk))
-            b.add(schema.TPU_POD_CHIP_COUNT, nchips, labels)
-            b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, labels)
+            b.add(schema.TPU_POD_CHIP_COUNT, nchips, rk)
+            b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
 
         # Self-metrics (SURVEY.md §5).
         b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)
@@ -271,15 +268,18 @@ class Collector:
         b.add(schema.TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS, self._wallclock())
 
         # Prune counter state for vanished chips/links (keep self-metric and
-        # error counters — they are node-lifetime).
-        keep = set(live_counter_keys)
-        for name in (
-            schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name,
-            schema.TPU_EXPORTER_POLLS_TOTAL.name,
-        ):
-            for lv, _ in self._counters.items_for(name):
-                keep.add((name, lv))
-        self._counters.prune(keep)
+        # error counters — they are node-lifetime). Only when we actually saw
+        # the devices this poll: pruning on a failed read would wipe ICI
+        # counter state and make the exported counters regress on recovery.
+        if host_sample is not None:
+            keep = set(live_counter_keys)
+            for name in (
+                schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name,
+                schema.TPU_EXPORTER_POLLS_TOTAL.name,
+            ):
+                for lv, _ in self._counters.items_for(name):
+                    keep.add((name, lv))
+            self._counters.prune(keep)
 
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))

@@ -20,10 +20,12 @@ class ExporterConfig:
     port: int = 8000
     host: str = "0.0.0.0"
     interval_s: float = 1.0
-    backend: str = "auto"          # auto | fake | jax | libtpu
+    backend: str = "auto"          # auto | fake | jax | libtpu | recorded
     attribution: str = "auto"      # auto | fake | podresources | checkpoint | none
     resource_name: str = "google.com/tpu"
     fake_chips: int = 0            # chip count when backend=fake
+    recording_path: str = ""       # JSONL trace to replay when backend=recorded
+    record_to: str = ""            # if set, record every poll's samples here
     podresources_socket: str = "/var/lib/kubelet/pod-resources/kubelet.sock"
     checkpoint_path: str = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
     libtpu_metrics_addr: str = "localhost:8431"

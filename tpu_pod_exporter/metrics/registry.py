@@ -113,6 +113,43 @@ class _Family:
     samples: dict[tuple[str, ...], float] = field(default_factory=dict)
 
 
+def render_prefix(spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
+    """The `metric{label="…"}` part of one exposition line — the single
+    source of truth for both the cached and uncached render paths."""
+    if not spec.label_names:
+        return spec.name.encode()
+    pairs = ",".join(
+        f'{ln}="{escape_label_value(lv)}"'
+        for ln, lv in zip(spec.label_names, lvs)
+    )
+    return f"{spec.name}{{{pairs}}}".encode()
+
+
+class PrefixCache:
+    """Rendered `metric{labels}` byte-prefixes, shared across polls.
+
+    Label sets are stable between churn events, so escaping + joining each
+    series' label block every poll is pure waste — the dominant CPU cost at
+    256 chips. Keyed by (metric name, label values tuple). Bounded: when the
+    cache outgrows ``max_entries`` it is cleared wholesale (churned-away
+    label sets would otherwise accumulate forever).
+    """
+
+    def __init__(self, max_entries: int = 65536) -> None:
+        self._cache: dict[tuple[str, tuple[str, ...]], bytes] = {}
+        self._max = max_entries
+
+    def prefix(self, spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
+        key = (spec.name, lvs)
+        p = self._cache.get(key)
+        if p is None:
+            p = render_prefix(spec, lvs)
+            if len(self._cache) >= self._max:
+                self._cache.clear()
+            self._cache[key] = p
+        return p
+
+
 class SnapshotBuilder:
     """Accumulates one poll's worth of samples, then freezes into a Snapshot.
 
@@ -122,15 +159,17 @@ class SnapshotBuilder:
     ``main.go:141-155``).
     """
 
-    def __init__(self) -> None:
+    def __init__(self, prefix_cache: PrefixCache | None = None) -> None:
         self._families: dict[str, _Family] = {}
         self._order: list[str] = []
+        self._prefix_cache = prefix_cache
 
     def declare(self, spec: MetricSpec) -> None:
         """Register a family so it appears (possibly sample-less) in output."""
         existing = self._families.get(spec.name)
         if existing is not None:
-            if existing.spec != spec:
+            # identity first: specs are module-level singletons on the hot path
+            if existing.spec is not spec and existing.spec != spec:
                 raise ValueError(f"conflicting redeclaration of {spec.name}")
             return
         self._families[spec.name] = _Family(spec)
@@ -142,9 +181,24 @@ class SnapshotBuilder:
         value: float,
         labels: Mapping[str, str] | Sequence[str] = (),
     ) -> None:
-        self.declare(spec)
-        fam = self._families[spec.name]
-        if isinstance(labels, Mapping):
+        fam = self._families.get(spec.name)
+        if fam is None:
+            self.declare(spec)
+            fam = self._families[spec.name]
+        elif fam.spec is not spec and fam.spec != spec:
+            raise ValueError(f"conflicting redeclaration of {spec.name}")
+        if type(labels) is tuple:
+            # Hot path (the collector): pre-ordered tuple of label values.
+            # Contract: elements are already strings — checked under
+            # assertions (tests), skipped with -O in production.
+            assert all(type(v) is str for v in labels), labels
+            values = labels
+            if len(values) != len(spec.label_names):
+                raise ValueError(
+                    f"{spec.name}: got {len(values)} label values, "
+                    f"want {len(spec.label_names)}"
+                )
+        elif isinstance(labels, Mapping):
             try:
                 values = tuple(str(labels[ln]) for ln in spec.label_names)
             except KeyError as e:
@@ -172,15 +226,22 @@ class SnapshotBuilder:
                 for name, f in ((n, self._families[n]) for n in self._order)
             },
             timestamp=time.time() if timestamp is None else timestamp,
+            prefix_cache=self._prefix_cache,
         )
 
 
 class Snapshot:
     """An immutable, pre-rendered view of all series at one poll instant."""
 
-    def __init__(self, families: dict[str, _Family], timestamp: float) -> None:
+    def __init__(
+        self,
+        families: dict[str, _Family],
+        timestamp: float,
+        prefix_cache: "PrefixCache | None" = None,
+    ) -> None:
         self._families = families
         self.timestamp = timestamp
+        self._prefix_cache = prefix_cache
         self._text: bytes | None = None
         self._gzipped: bytes | None = None
 
@@ -222,6 +283,7 @@ class Snapshot:
         except ImportError:  # partial deployment: never let encode() die
             native = None
 
+        cache = self._prefix_cache
         chunks: list[bytes] = []
         for fam in self._families.values():
             spec = fam.spec
@@ -233,17 +295,14 @@ class Snapshot:
                 continue
             prefixes: list[bytes] = []
             values: list[float] = []
-            if not spec.label_names:
-                for _, value in fam.samples.items():
-                    prefixes.append(spec.name.encode())
+            if cache is not None:
+                pfx = cache.prefix
+                for lvs, value in fam.samples.items():
+                    prefixes.append(pfx(spec, lvs))
                     values.append(value)
             else:
                 for lvs, value in fam.samples.items():
-                    pairs = ",".join(
-                        f'{ln}="{escape_label_value(lv)}"'
-                        for ln, lv in zip(spec.label_names, lvs)
-                    )
-                    prefixes.append(f"{spec.name}{{{pairs}}}".encode())
+                    prefixes.append(render_prefix(spec, lvs))
                     values.append(value)
             rendered = native.render_lines(prefixes, values) if native else None
             if rendered is None:

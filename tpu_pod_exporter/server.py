@@ -33,12 +33,25 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 class _Handler(BaseHTTPRequestHandler):
     # set by server factory
     store: SnapshotStore
+    debug_vars = None  # optional callable -> dict
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
         path = self.path.split("?", 1)[0]
         if path == "/metrics":
             self._serve_metrics()
+        elif path == "/debug/vars" and self.debug_vars is not None:
+            import json
+
+            try:
+                body = json.dumps(type(self).debug_vars(), indent=1).encode()
+            except Exception as e:  # noqa: BLE001 — debug must not 500 loops
+                body = json.dumps({"error": str(e)}).encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         elif path == "/healthz":
             self._serve_text(200, b"ok\n")
         elif path == "/readyz":
@@ -94,8 +107,18 @@ class MetricsServer:
     ``log.Fatal`` on listener death, ``main.go:71``), port 0 is allowed for
     tests (ephemeral) and shutdown is clean."""
 
-    def __init__(self, store: SnapshotStore, host: str = "0.0.0.0", port: int = 8000) -> None:
-        handler = type("BoundHandler", (_Handler,), {"store": store})
+    def __init__(
+        self,
+        store: SnapshotStore,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        debug_vars=None,
+    ) -> None:
+        handler = type(
+            "BoundHandler",
+            (_Handler,),
+            {"store": store, "debug_vars": staticmethod(debug_vars) if debug_vars else None},
+        )
         self._httpd = _Server((host, port), handler)
         self._thread: threading.Thread | None = None
 
