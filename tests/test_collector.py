@@ -101,6 +101,41 @@ class TestJoin:
         assert snap.value("tpu_pod_hbm_used_bytes", rollup) == 4 * 4 * 1024**3
 
 
+class TestLegacyMetrics:
+    def test_disabled_by_default(self, store, four_chip_backend, one_pod_attribution):
+        c = make_collector(four_chip_backend, one_pod_attribution, store)
+        c.poll_once()
+        text = store.current().encode()
+        assert b"pod_gpu_memory_usage" not in text
+
+    def test_reference_names_emitted_when_enabled(
+        self, store, four_chip_backend, one_pod_attribution
+    ):
+        c = make_collector(
+            four_chip_backend, one_pod_attribution, store, legacy_metrics=True
+        )
+        c.poll_once()
+        snap = store.current()
+        # per-pod sum over 4 chips × 4 GiB, pid always ""
+        assert snap.value("pod_gpu_memory_usage", ("", "train-job-0")) == 16 * 1024**3
+        assert snap.value("docker_gpu_memory_perc_usage", ("", "train-job-0")) == 12.5
+        assert b"DEPRECATED" in snap.encode()
+
+    def test_same_name_pods_sum_across_namespaces(self, store):
+        backend = FakeBackend(
+            chips=2, script=FakeChipScript(hbm_total_bytes=100.0, hbm_used_bytes=10.0)
+        )
+        attr = FakeAttribution(
+            [
+                simple_allocation("job", ["0"], namespace="alpha"),
+                simple_allocation("job", ["1"], namespace="beta"),
+            ]
+        )
+        c = make_collector(backend, attr, store, legacy_metrics=True)
+        c.poll_once()
+        assert store.current().value("pod_gpu_memory_usage", ("", "job")) == 20.0
+
+
 class TestSeriesLifecycle:
     def test_stale_series_gone_after_pod_exit(self, store, four_chip_backend):
         attr = FakeAttribution([simple_allocation("ephemeral", ["0", "1", "2", "3"])])

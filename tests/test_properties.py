@@ -1,0 +1,99 @@
+"""Property-based tests (hypothesis) for the exposition and counter layers.
+
+SURVEY.md §4 calls for a pytest+hypothesis harness; these lock the two most
+corruption-prone invariants:
+- any label value / any float survives encode → Prometheus-parser roundtrip,
+- CounterStore never regresses regardless of the raw counter sequence.
+"""
+
+import math
+
+from hypothesis import given, settings, strategies as st
+from prometheus_client.parser import text_string_to_metric_families
+
+from tpu_pod_exporter.metrics.registry import (
+    CounterStore,
+    MetricSpec,
+    SnapshotBuilder,
+    format_value,
+)
+
+# Any printable-ish text, plus the escape-relevant characters; NULs are
+# stripped by design (they would truncate the native render path).
+label_values = st.text(
+    alphabet=st.characters(blacklist_categories=("Cs",), blacklist_characters="\x00"),
+    max_size=50,
+)
+
+finite_floats = st.floats(allow_nan=False, allow_infinity=False, width=64)
+
+
+class TestExpositionRoundtrip:
+    @given(value=label_values, metric_value=finite_floats)
+    @settings(max_examples=200, deadline=None)
+    def test_any_label_value_roundtrips(self, value, metric_value):
+        spec = MetricSpec(name="m", help="h", label_names=("l",))
+        b = SnapshotBuilder()
+        b.add(spec, metric_value, (value,))
+        text = b.build().encode().decode()
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        (sample,) = fams["m"].samples
+        assert sample.labels["l"] == value
+        assert sample.value == metric_value or (
+            math.isnan(sample.value) and math.isnan(metric_value)
+        )
+
+    @given(values=st.lists(finite_floats, min_size=1, max_size=20, unique=True))
+    @settings(max_examples=100, deadline=None)
+    def test_distinct_series_all_survive(self, values):
+        spec = MetricSpec(name="m", help="h", label_names=("i",))
+        b = SnapshotBuilder()
+        for i, v in enumerate(values):
+            b.add(spec, v, (str(i),))
+        text = b.build().encode().decode()
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        assert len(fams["m"].samples) == len(values)
+
+    @given(v=st.floats(width=64))
+    @settings(max_examples=300, deadline=None)
+    def test_format_value_roundtrips_every_float(self, v):
+        s = format_value(v)
+        parsed = float(s.replace("+Inf", "inf").replace("-Inf", "-inf"))
+        if math.isnan(v):
+            assert math.isnan(parsed)
+        else:
+            assert parsed == v
+
+    @given(help_text=label_values)
+    @settings(max_examples=100, deadline=None)
+    def test_any_help_text_parses(self, help_text):
+        spec = MetricSpec(name="m", help=help_text)
+        b = SnapshotBuilder()
+        b.add(spec, 1.0)
+        list(text_string_to_metric_families(b.build().encode().decode()))
+
+
+class TestCounterMonotonicity:
+    @given(raws=st.lists(st.floats(min_value=0, max_value=1e15), min_size=1, max_size=50))
+    @settings(max_examples=200, deadline=None)
+    def test_observe_total_never_regresses(self, raws):
+        c = CounterStore()
+        prev = 0.0
+        for raw in raws:
+            out = c.observe_total("n", (), raw)
+            assert out >= prev
+            prev = out
+
+    @given(
+        deltas=st.lists(
+            st.floats(min_value=-100, max_value=1e9, allow_nan=False), max_size=50
+        )
+    )
+    @settings(max_examples=100, deadline=None)
+    def test_inc_never_regresses(self, deltas):
+        c = CounterStore()
+        prev = 0.0
+        for d in deltas:
+            out = c.inc("n", (), d)
+            assert out >= prev
+            prev = out

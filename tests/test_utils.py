@@ -1,0 +1,61 @@
+"""RateLimitedLogger tests (SURVEY.md §5: leveled, rate-limited logging)."""
+
+import logging
+
+from tpu_pod_exporter.utils import RateLimitedLogger
+
+
+def make(clock_value, min_interval=30.0):
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    logger = logging.getLogger(f"test-rl-{id(records)}")
+    logger.setLevel(logging.DEBUG)
+    logger.addHandler(Capture())
+    logger.propagate = False
+    rl = RateLimitedLogger(logger, min_interval_s=min_interval, clock=lambda: clock_value[0])
+    return rl, records
+
+
+class TestRateLimitedLogger:
+    def test_first_emits_repeats_suppressed(self):
+        now = [0.0]
+        rl, records = make(now)
+        for _ in range(10):
+            rl.warning("k", "backend down: %s", "err")
+        assert records == ["backend down: err"]
+
+    def test_suppressed_count_reported_after_window(self):
+        now = [0.0]
+        rl, records = make(now)
+        for _ in range(5):
+            rl.warning("k", "boom")
+        now[0] = 31.0
+        rl.warning("k", "boom")
+        assert records == ["boom", "boom (+4 similar suppressed)"]
+
+    def test_stale_counts_not_attributed_to_new_incident(self):
+        now = [0.0]
+        rl, records = make(now)
+        for _ in range(5):
+            rl.warning("k", "old incident")
+        now[0] = 100000.0  # days later, unrelated fault
+        rl.warning("k", "new incident")
+        assert records == ["old incident", "new incident"]
+
+    def test_distinct_keys_independent(self):
+        now = [0.0]
+        rl, records = make(now)
+        rl.warning("a", "a-msg")
+        rl.warning("b", "b-msg")
+        assert records == ["a-msg", "b-msg"]
+
+    def test_levels(self):
+        now = [0.0]
+        rl, records = make(now)
+        rl.info("i", "info-msg")
+        rl.error("e", "error-msg")
+        assert records == ["info-msg", "error-msg"]

@@ -142,6 +142,7 @@ class ExporterApp:
             topology=topo,
             resource_name=cfg.resource_name,
             attribution_max_stale_s=cfg.attribution_max_stale_s,
+            legacy_metrics=cfg.legacy_metrics,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         self.server = MetricsServer(

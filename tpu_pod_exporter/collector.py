@@ -40,6 +40,7 @@ from tpu_pod_exporter.metrics import CounterStore, Snapshot, SnapshotBuilder, Sn
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.registry import PrefixCache
 from tpu_pod_exporter.topology import HostTopology
+from tpu_pod_exporter.utils import RateLimitedLogger
 from tpu_pod_exporter.version import __version__
 
 log = logging.getLogger("tpu_pod_exporter.collector")
@@ -67,6 +68,7 @@ class Collector:
         topology: HostTopology | None = None,
         resource_name: str = TPU_RESOURCE_NAME,
         attribution_max_stale_s: float = 30.0,
+        legacy_metrics: bool = False,
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
@@ -76,10 +78,16 @@ class Collector:
         self._topology = topology or HostTopology()
         self._resource_name = resource_name
         self._attribution_max_stale_s = attribution_max_stale_s
+        self._legacy_metrics = legacy_metrics
         self._clock = clock
         self._wallclock = wallclock
 
         self._counters = CounterStore()
+        # Poll-phase faults repeat every interval (1 s) while a source is
+        # down; rate-limit per fault key so logs show the fault, not 86k
+        # lines/day. Per-instance: multiple collectors (tests, bench)
+        # must not suppress each other.
+        self._rlog = RateLimitedLogger(log)
         self._prefix_cache = PrefixCache()
         # Topology labels are fixed for the process lifetime; pre-order them
         # once for the tuple fast path (CHIP_LABELS[2:6]).
@@ -107,13 +115,13 @@ class Collector:
             host_sample = self._backend.sample()
             for msg in host_sample.partial_errors:
                 errors.append("device_partial")
-                log.warning("device partial error: %s", msg)
+                self._rlog.warning("device_partial", "device partial error: %s", msg)
         except BackendError as e:
             errors.append("device_read")
-            log.warning("device read failed: %s", e)
+            self._rlog.warning("device_read", "device read failed: %s", e)
         except Exception as e:  # noqa: BLE001 — never die in the loop
             errors.append("device_read")
-            log.error("device read failed unexpectedly: %s", e, exc_info=True)
+            self._rlog.error("device_read_unexpected", "device read failed unexpectedly: %s", e, exc_info=True)
         td1 = self._clock()
 
         # Phase 2: attribution (replaces main.go:74-114).
@@ -148,10 +156,10 @@ class Collector:
             return snap
         except AttributionError as e:
             errors.append("attribution")
-            log.warning("attribution read failed: %s", e)
+            self._rlog.warning("attribution", "attribution read failed: %s", e)
         except Exception as e:  # noqa: BLE001
             errors.append("attribution")
-            log.error("attribution failed unexpectedly: %s", e, exc_info=True)
+            self._rlog.error("attribution_unexpected", "attribution failed unexpectedly: %s", e, exc_info=True)
         # Bounded-staleness reuse of the last good snapshot.
         if (
             self._last_attr is not None
@@ -169,9 +177,12 @@ class Collector:
         # even when sample-less — scrapers see a stable surface from poll #1.
         for spec in schema.ALL_SPECS:
             b.declare(spec)
+        if self._legacy_metrics:
+            b.declare(schema.LEGACY_POD_MEMORY_USAGE)
+            b.declare(schema.LEGACY_POD_MEMORY_PERC_USAGE)
 
         live_counter_keys: set[tuple[str, tuple[str, ...]]] = set()
-        pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used]
+        pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used, hbm_total]
         ici_now: dict[tuple[str, str], float] = {}
 
         if host_sample is not None:
@@ -227,16 +238,34 @@ class Collector:
 
                 if owner is not None:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
-                    agg = pod_rollup.setdefault(rk, [0.0, 0.0])
+                    agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0.0])
                     agg[0] += 1.0
                     agg[1] += chip.hbm_used_bytes
+                    agg[2] += chip.hbm_total_bytes
 
             self._prev_ici_totals = ici_now
             self._prev_ici_at = now_mono
 
-        for rk, (nchips, hbm) in pod_rollup.items():
+        legacy_rollup: dict[str, list[float]] = {}
+        for rk, (nchips, hbm, hbm_total) in pod_rollup.items():
             b.add(schema.TPU_POD_CHIP_COUNT, nchips, rk)
             b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
+            if self._legacy_metrics:
+                # The legacy shape has no namespace label (the reference
+                # collided on pod name, main.go:113); sum across namespaces
+                # rather than last-write-wins.
+                agg = legacy_rollup.setdefault(rk[0], [0.0, 0.0])
+                agg[0] += hbm
+                agg[1] += hbm_total
+        for pod, (hbm, hbm_total) in legacy_rollup.items():
+            # Reference-name aliases (main.go:24,31): {pid, pod} with pid
+            # always "" — see schema.LEGACY_* docstrings.
+            b.add(schema.LEGACY_POD_MEMORY_USAGE, hbm, ("", pod))
+            b.add(
+                schema.LEGACY_POD_MEMORY_PERC_USAGE,
+                schema.hbm_used_percent(hbm, hbm_total),
+                ("", pod),
+            )
 
         # Self-metrics (SURVEY.md §5).
         b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)

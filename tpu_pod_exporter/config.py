@@ -30,6 +30,7 @@ class ExporterConfig:
     checkpoint_path: str = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0
+    legacy_metrics: bool = False   # also emit the reference's gpu_* metric names
     accelerator: str = ""          # override TPU_ACCELERATOR_TYPE
     slice_name: str = ""
     node_name: str = ""
@@ -58,7 +59,26 @@ class ExporterConfig:
         )
         for f in fields(cls):
             flag = "--" + f.name.replace("_", "-")
-            default = cls._env_default(f.name, getattr(defaults, f.name))
-            p.add_argument(flag, type=type(getattr(defaults, f.name)), default=default)
+            base = getattr(defaults, f.name)
+            default = cls._env_default(f.name, base)
+            if isinstance(base, bool):
+                # argparse type=bool is a trap: bool("false") is True. And a
+                # typo ("--legacy-metrics on") must fail loudly, not parse
+                # as False.
+                def parse_bool(s: str) -> bool:
+                    low = s.lower()
+                    if low in ("1", "true", "yes"):
+                        return True
+                    if low in ("0", "false", "no"):
+                        return False
+                    raise argparse.ArgumentTypeError(
+                        f"expected true/false, got {s!r}"
+                    )
+
+                p.add_argument(
+                    flag, type=parse_bool, default=default, nargs="?", const=True
+                )
+            else:
+                p.add_argument(flag, type=type(base), default=default)
         ns = p.parse_args(argv)
         return cls(**{f.name: getattr(ns, f.name) for f in fields(cls)})

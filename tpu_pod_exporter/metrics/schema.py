@@ -155,6 +155,26 @@ TPU_EXPORTER_INFO = MetricSpec(
     label_names=("version", "backend", "attribution"),
 )
 
+# --- Legacy migration aliases (off by default; --legacy-metrics) ------------
+# The reference's exact metric names (main.go:24,31) so its dashboards work
+# unchanged during migration. Semantic shift, documented in the help text:
+# the reference's value was per-process GPU memory keyed {pid, pod}
+# (main.go:147-150); TPU runtimes pin whole chips to one container, so the
+# honest equivalent is per-pod totals and pid is always "".
+LEGACY_POD_MEMORY_USAGE = MetricSpec(
+    name="pod_gpu_memory_usage",
+    help="DEPRECATED migration alias: device memory used by this pod's chips, bytes (TPU: per-pod HBM; pid label is always empty).",
+    type=GAUGE,
+    label_names=("pid", "pod"),
+)
+
+LEGACY_POD_MEMORY_PERC_USAGE = MetricSpec(
+    name="docker_gpu_memory_perc_usage",
+    help="DEPRECATED migration alias: percent of this pod's chips' total device memory in use (pid label is always empty).",
+    type=GAUGE,
+    label_names=("pid", "pod"),
+)
+
 ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_HBM_USED_BYTES,
     TPU_HBM_TOTAL_BYTES,

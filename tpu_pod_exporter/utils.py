@@ -1,0 +1,54 @@
+"""Small shared utilities.
+
+RateLimitedLogger: the reference prints per-pod PID dumps every 30 s cycle
+(``main.go:81,89,104,108``) — at a 1 s interval the equivalent would be
+86 400 lines/day per failing source. Repeated messages are keyed and
+suppressed within a window; the next emission reports how many were
+dropped, so operators see both the fault and its frequency.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+
+class RateLimitedLogger:
+    def __init__(
+        self,
+        logger: logging.Logger,
+        min_interval_s: float = 30.0,
+        clock=time.monotonic,
+    ) -> None:
+        self._logger = logger
+        self._min_interval_s = min_interval_s
+        self._clock = clock
+        self._last_emit: dict[str, float] = {}
+        # key -> (count, last suppression time); counts expire with the
+        # window so an old incident's tally is never attributed to a new one.
+        self._suppressed: dict[str, tuple[int, float]] = {}
+
+    def _emit(self, level: int, key: str, msg: str, *args, **kwargs) -> None:
+        now = self._clock()
+        last = self._last_emit.get(key)
+        if last is not None and now - last < self._min_interval_s:
+            count, _ = self._suppressed.get(key, (0, now))
+            self._suppressed[key] = (count + 1, now)
+            return
+        dropped, dropped_at = self._suppressed.pop(key, (0, 0.0))
+        # Report a tally only if the suppressed burst is recent (within two
+        # windows) — a count left over from an incident days ago must not be
+        # attributed to a new, unrelated fault.
+        if dropped and now - dropped_at <= 2 * self._min_interval_s:
+            msg = f"{msg} (+{dropped} similar suppressed)"
+        self._last_emit[key] = now
+        self._logger.log(level, msg, *args, **kwargs)
+
+    def warning(self, key: str, msg: str, *args, **kwargs) -> None:
+        self._emit(logging.WARNING, key, msg, *args, **kwargs)
+
+    def error(self, key: str, msg: str, *args, **kwargs) -> None:
+        self._emit(logging.ERROR, key, msg, *args, **kwargs)
+
+    def info(self, key: str, msg: str, *args, **kwargs) -> None:
+        self._emit(logging.INFO, key, msg, *args, **kwargs)
