@@ -93,13 +93,27 @@ class TestSnapshotFromResponse:
 class _FakeLister:
     """Scripted PodResourcesLister served over a real unix socket."""
 
-    def __init__(self, response):
+    def __init__(self, response, allocatable_ids=None, allocatable_unimplemented=False):
         self.response = response
+        self.allocatable_ids = allocatable_ids
+        self.allocatable_unimplemented = allocatable_unimplemented
         self.calls = 0
+        self.allocatable_calls = 0
 
     def __call__(self, request, context):
         self.calls += 1
         return self.response
+
+    def get_allocatable(self, request, context):
+        self.allocatable_calls += 1
+        if self.allocatable_unimplemented:
+            context.abort(grpc.StatusCode.UNIMPLEMENTED, "old kubelet")
+        resp = pb.AllocatableResourcesResponse()
+        if self.allocatable_ids is not None:
+            d = resp.devices.add()
+            d.resource_name = TPU_RESOURCE_NAME
+            d.device_ids.extend(self.allocatable_ids)
+        return resp
 
 
 def serve_lister(socket_path, lister):
@@ -111,7 +125,12 @@ def serve_lister(socket_path, lister):
                 lister,
                 request_deserializer=pb.ListPodResourcesRequest.FromString,
                 response_serializer=pb.ListPodResourcesResponse.SerializeToString,
-            )
+            ),
+            "GetAllocatableResources": grpc.unary_unary_rpc_method_handler(
+                lister.get_allocatable,
+                request_deserializer=pb.AllocatableResourcesRequest.FromString,
+                response_serializer=pb.AllocatableResourcesResponse.SerializeToString,
+            ),
         },
     )
     server.add_generic_rpc_handlers((handler,))
@@ -137,6 +156,37 @@ class TestPodResourcesGrpc:
             # channel reused across polls
             provider.snapshot()
             assert lister.calls == 2
+            provider.close()
+        finally:
+            server.stop(0)
+
+    def test_allocatable_inventory_reported(self, tmp_path):
+        sock = str(tmp_path / "kubelet.sock")
+        lister = _FakeLister(
+            make_response([("p", "ns", [("c", TPU_RESOURCE_NAME, ["0"])])]),
+            allocatable_ids=["0", "1", "2", "3"],
+        )
+        server = serve_lister(sock, lister)
+        try:
+            provider = PodResourcesAttribution(socket_path=sock)
+            snap = provider.snapshot()
+            assert snap.allocatable_device_ids == ("0", "1", "2", "3")
+            provider.close()
+        finally:
+            server.stop(0)
+
+    def test_allocatable_unimplemented_probed_once(self, tmp_path):
+        sock = str(tmp_path / "kubelet.sock")
+        lister = _FakeLister(
+            make_response([("p", "ns", [("c", TPU_RESOURCE_NAME, ["0"])])]),
+            allocatable_unimplemented=True,
+        )
+        server = serve_lister(sock, lister)
+        try:
+            provider = PodResourcesAttribution(socket_path=sock)
+            assert provider.snapshot().allocatable_device_ids is None
+            assert provider.snapshot().allocatable_device_ids is None
+            assert lister.allocatable_calls == 1  # not re-probed
             provider.close()
         finally:
             server.stop(0)

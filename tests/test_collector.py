@@ -101,6 +101,52 @@ class TestJoin:
         assert snap.value("tpu_pod_hbm_used_bytes", rollup) == 4 * 4 * 1024**3
 
 
+class TestKubeletInventory:
+    def test_allocatable_and_allocated_gauges(self, store, four_chip_backend):
+        attr = FakeAttribution(
+            [simple_allocation("p", ["0", "1"])],
+            allocatable=["0", "1", "2", "3"],
+        )
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        snap = store.current()
+        topo = ("v4-8", "s0", "host0", "0")
+        assert snap.value("tpu_kubelet_allocatable_chips", topo) == 4
+        assert snap.value("tpu_kubelet_allocated_chips", topo) == 2
+
+    def test_idle_node_with_inventory_reports_zero_allocated(
+        self, store, four_chip_backend
+    ):
+        attr = FakeAttribution([], allocatable=["0", "1", "2", "3"])
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        snap = store.current()
+        topo = ("v4-8", "s0", "host0", "0")
+        assert snap.value("tpu_kubelet_allocatable_chips", topo) == 4
+        # 0 is real data (alertable), not absence
+        assert snap.value("tpu_kubelet_allocated_chips", topo) == 0
+
+    def test_inventory_survives_pod_churn(self, store, four_chip_backend):
+        attr = FakeAttribution(
+            [simple_allocation("p", ["0"])], allocatable=["0", "1", "2", "3"]
+        )
+        c = make_collector(four_chip_backend, attr, store)
+        c.poll_once()
+        attr.set_allocations([])  # pod exits; kubelet inventory unchanged
+        c.poll_once()
+        snap = store.current()
+        topo = ("v4-8", "s0", "host0", "0")
+        assert snap.value("tpu_kubelet_allocatable_chips", topo) == 4
+        assert snap.value("tpu_kubelet_allocated_chips", topo) == 0
+
+    def test_absent_when_source_cannot_report(self, store, four_chip_backend):
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        c.poll_once()
+        snap = store.current()
+        assert snap.samples("tpu_kubelet_allocatable_chips") == {}
+        assert snap.samples("tpu_kubelet_allocated_chips") == {}
+
+
 class TestLegacyMetrics:
     def test_disabled_by_default(self, store, four_chip_backend, one_pod_attribution):
         c = make_collector(four_chip_backend, one_pod_attribution, store)

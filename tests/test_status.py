@@ -1,0 +1,53 @@
+"""Status CLI tests — drive main() with fake-backend flags, capture stdout."""
+
+import pytest
+
+from tpu_pod_exporter import status
+
+
+@pytest.fixture
+def run_status(capsys, monkeypatch):
+    def run(argv):
+        # isolate from the host's TPU env
+        for var in ("TPU_ACCELERATOR_TYPE", "TPU_WORKER_ID", "TPU_SLICE_NAME"):
+            monkeypatch.delenv(var, raising=False)
+        rc = status.main(argv)
+        out = capsys.readouterr()
+        return rc, out.out, out.err
+
+    return run
+
+
+class TestStatusCli:
+    def test_zero_devices(self, run_status):
+        rc, out, _ = run_status(["--backend", "fake", "--fake-chips", "0",
+                                 "--attribution", "none"])
+        assert rc == 0
+        assert "no TPU chips found" in out
+
+    def test_chip_table(self, run_status):
+        rc, out, _ = run_status(["--backend", "fake", "--fake-chips", "4",
+                                 "--attribution", "none", "--accelerator", "v4-8"])
+        assert rc == 0
+        assert "accelerator: v4-8" in out
+        assert "(4 chips / 1 hosts slice-wide)" in out
+        for chip in range(4):
+            assert f"/dev/accel{chip}" in out
+
+    def test_recorded_trace(self, run_status, tmp_path):
+        from tpu_pod_exporter.backend.fake import FakeBackend
+        from tpu_pod_exporter.backend.recorded import RecordingBackend
+
+        path = str(tmp_path / "t.jsonl")
+        rec = RecordingBackend(FakeBackend(chips=2), path)
+        rec.sample()
+        rec.close()
+        rc, out, _ = run_status(["--backend", "recorded", "--recording-path", path,
+                                 "--attribution", "none"])
+        assert rc == 0
+        assert "chip" in out and "/dev/accel1" in out
+
+    def test_fmt_bytes(self):
+        assert status.fmt_bytes(0) == "0B"
+        assert status.fmt_bytes(1024) == "1.0KiB"
+        assert status.fmt_bytes(32 * 1024**3) == "32.0GiB"

@@ -100,7 +100,9 @@ def _build_named_attribution(choice: str, cfg: ExporterConfig) -> AttributionPro
     if choice == "podresources":
         from tpu_pod_exporter.attribution.podresources import PodResourcesAttribution
 
-        return PodResourcesAttribution(socket_path=cfg.podresources_socket)
+        return PodResourcesAttribution(
+            socket_path=cfg.podresources_socket, resource_name=cfg.resource_name
+        )
     if choice == "checkpoint":
         from tpu_pod_exporter.attribution.checkpoint import CheckpointAttribution
 

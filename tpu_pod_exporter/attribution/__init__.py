@@ -48,9 +48,15 @@ class DeviceAllocation:
 
 @dataclass(frozen=True)
 class AttributionSnapshot:
-    """All allocations on this node at one instant."""
+    """All allocations on this node at one instant.
+
+    ``allocatable_device_ids`` is the kubelet's full device-plugin inventory
+    for the resource (GetAllocatableResources); None when the source cannot
+    report it (checkpoint fallback, old kubelets).
+    """
 
     allocations: tuple[DeviceAllocation, ...] = ()
+    allocatable_device_ids: tuple[str, ...] | None = None
 
     def by_device_id(self, resource_name: str = TPU_RESOURCE_NAME) -> dict[str, DeviceAllocation]:
         """device_id -> owning allocation. Kubelet guarantees a device is

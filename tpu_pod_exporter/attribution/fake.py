@@ -21,17 +21,39 @@ from tpu_pod_exporter.attribution import (
 class FakeAttribution(AttributionProvider):
     name = "fake"
 
-    def __init__(self, allocations: Sequence[DeviceAllocation] = ()) -> None:
+    def __init__(
+        self,
+        allocations: Sequence[DeviceAllocation] = (),
+        allocatable: Sequence[str] | None = None,
+    ) -> None:
         self._lock = threading.Lock()
-        self._snapshot = AttributionSnapshot(tuple(allocations))
+        self._snapshot = AttributionSnapshot(
+            tuple(allocations),
+            allocatable_device_ids=tuple(allocatable) if allocatable is not None else None,
+        )
         self._fail_next = 0
         self.snapshot_calls = 0
         self.closed = False
 
-    def set_allocations(self, allocations: Iterable[DeviceAllocation]) -> None:
-        snap = AttributionSnapshot(tuple(allocations))
+    _KEEP = object()  # sentinel: preserve current allocatable on churn
+
+    def set_allocations(
+        self,
+        allocations: Iterable[DeviceAllocation],
+        allocatable: "Sequence[str] | None | object" = _KEEP,
+    ) -> None:
         with self._lock:
-            self._snapshot = snap
+            if allocatable is FakeAttribution._KEEP:
+                # Real kubelets keep reporting the device inventory across
+                # pod churn; the fake must too unless explicitly overridden.
+                alloc_ids = self._snapshot.allocatable_device_ids
+            else:
+                alloc_ids = (
+                    tuple(allocatable) if allocatable is not None else None  # type: ignore[arg-type]
+                )
+            self._snapshot = AttributionSnapshot(
+                tuple(allocations), allocatable_device_ids=alloc_ids
+            )
 
     def fail_next(self, n: int = 1) -> None:
         with self._lock:

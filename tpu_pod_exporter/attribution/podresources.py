@@ -18,6 +18,7 @@ import logging
 import threading
 
 from tpu_pod_exporter.attribution import (
+    TPU_RESOURCE_NAME,
     AttributionError,
     AttributionProvider,
     AttributionSnapshot,
@@ -32,9 +33,21 @@ GET_ALLOCATABLE_METHOD = "/v1.PodResourcesLister/GetAllocatableResources"
 DEFAULT_SOCKET = "/var/lib/kubelet/pod-resources/kubelet.sock"
 
 
+def allocatable_from_response(
+    resp: "pb.AllocatableResourcesResponse", resource_name: str
+) -> tuple[str, ...]:
+    """GetAllocatableResources → device IDs for one resource."""
+    ids: list[str] = []
+    for dev in resp.devices:
+        if dev.resource_name == resource_name:
+            ids.extend(dev.device_ids)
+    return tuple(sorted(set(ids)))
+
+
 def snapshot_from_response(
     resp: "pb.ListPodResourcesResponse",
     resource_prefixes: tuple[str, ...] = (),
+    allocatable: tuple[str, ...] | None = None,
 ) -> AttributionSnapshot:
     """Pure conversion: protobuf → AttributionSnapshot (unit-testable with
     no socket). When ``resource_prefixes`` is non-empty, only matching
@@ -59,7 +72,7 @@ def snapshot_from_response(
                         resource_name=dev.resource_name,
                     )
                 )
-    return AttributionSnapshot(tuple(allocations))
+    return AttributionSnapshot(tuple(allocations), allocatable_device_ids=allocatable)
 
 
 class PodResourcesAttribution(AttributionProvider):
@@ -70,6 +83,7 @@ class PodResourcesAttribution(AttributionProvider):
         socket_path: str = DEFAULT_SOCKET,
         timeout_s: float = 2.0,
         target: str | None = None,
+        resource_name: str = TPU_RESOURCE_NAME,
     ) -> None:
         """``target`` overrides the unix-socket URI (tests use tmpdir sockets)."""
         import grpc  # deferred: keep import cost off the fake-only path
@@ -77,9 +91,14 @@ class PodResourcesAttribution(AttributionProvider):
         self._grpc = grpc
         self._target = target if target is not None else f"unix://{socket_path}"
         self._timeout_s = timeout_s
+        self._resource_name = resource_name
         self._lock = threading.Lock()
         self._channel = None
         self._list = None
+        self._get_allocatable = None
+        # GetAllocatableResources needs kubelet >=1.23 (and a feature gate on
+        # older ones); probed once, degraded to None thereafter.
+        self._allocatable_supported: bool | None = None
 
     def _ensure_channel(self) -> None:
         with self._lock:
@@ -98,6 +117,11 @@ class PodResourcesAttribution(AttributionProvider):
                 request_serializer=pb.ListPodResourcesRequest.SerializeToString,
                 response_deserializer=pb.ListPodResourcesResponse.FromString,
             )
+            self._get_allocatable = self._channel.unary_unary(
+                GET_ALLOCATABLE_METHOD,
+                request_serializer=pb.AllocatableResourcesRequest.SerializeToString,
+                response_deserializer=pb.AllocatableResourcesResponse.FromString,
+            )
 
     def snapshot(self) -> AttributionSnapshot:
         try:
@@ -110,7 +134,28 @@ class PodResourcesAttribution(AttributionProvider):
         except Exception as e:  # noqa: BLE001
             self._reset_channel()
             raise AttributionError(f"podresources List failed: {e}") from e
-        return snapshot_from_response(resp)
+        return snapshot_from_response(resp, allocatable=self._read_allocatable())
+
+    def _read_allocatable(self) -> tuple[str, ...] | None:
+        """Best-effort inventory read; never fails the attribution poll."""
+        if self._allocatable_supported is False:
+            return None
+        try:
+            resp = self._get_allocatable(
+                pb.AllocatableResourcesRequest(), timeout=self._timeout_s
+            )
+        except self._grpc.RpcError as e:
+            if self._allocatable_supported is None and e.code() in (
+                self._grpc.StatusCode.UNIMPLEMENTED,
+                self._grpc.StatusCode.NOT_FOUND,
+            ):
+                log.info("GetAllocatableResources unsupported by this kubelet")
+                self._allocatable_supported = False
+            return None
+        except Exception:  # noqa: BLE001
+            return None
+        self._allocatable_supported = True
+        return allocatable_from_response(resp, self._resource_name)
 
     def _reset_channel(self) -> None:
         with self._lock:
@@ -121,6 +166,7 @@ class PodResourcesAttribution(AttributionProvider):
                     pass
             self._channel = None
             self._list = None
+            self._get_allocatable = None
 
     def close(self) -> None:
         self._reset_channel()

@@ -130,6 +130,16 @@ class Collector:
 
         # Phase 3: join (replaces main.go:141-154).
         device_owner = attr.by_device_id(self._resource_name) if attr else {}
+        allocatable = attr.allocatable_device_ids if attr else None
+        # None ⇒ "source cannot report"; 0 is a real, publishable value on an
+        # idle node. A source that reports neither allocations nor inventory
+        # (attribution disabled / "none") stays absent rather than claiming 0.
+        allocated = (
+            len(device_owner)
+            if attr is not None
+            and (attr.allocations or attr.allocatable_device_ids is not None)
+            else None
+        )
         tj1 = self._clock()
 
         # Phase 4: publish.
@@ -140,7 +150,8 @@ class Collector:
             ok="device_read" not in errors,
             errors=tuple(errors),
         )
-        self._publish(host_sample, device_owner, stats, now_mono=tj1)
+        self._publish(host_sample, device_owner, stats, now_mono=tj1,
+                      allocatable=allocatable, allocated=allocated)
         tp1 = self._clock()
         stats.publish_s = tp1 - tj1
         stats.total_s = tp1 - t0
@@ -170,7 +181,8 @@ class Collector:
 
     # --------------------------------------------------------------- publish
 
-    def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float) -> None:
+    def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float,
+                 allocatable=None, allocated=None) -> None:
         b = SnapshotBuilder(prefix_cache=self._prefix_cache)
 
         # Declare the full schema up front so families are present (and typed)
@@ -266,6 +278,15 @@ class Collector:
                 schema.hbm_used_percent(hbm, hbm_total),
                 ("", pod),
             )
+
+        # Kubelet inventory (absent when the source cannot report it; an
+        # allocated count of 0 on an idle node is real data, not absence).
+        if allocatable is not None:
+            b.add(schema.TPU_KUBELET_ALLOCATABLE_CHIPS, len(allocatable),
+                  self._topo_tuple)
+        if allocated is not None:
+            b.add(schema.TPU_KUBELET_ALLOCATED_CHIPS, allocated,
+                  self._topo_tuple)
 
         # Self-metrics (SURVEY.md §5).
         b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)

@@ -108,6 +108,26 @@ TPU_POD_HBM_USED_BYTES = MetricSpec(
     label_names=POD_LABELS,
 )
 
+# --- Kubelet inventory (podresources GetAllocatableResources) ----------------
+
+# Derived, not restated: the collector's _topo_tuple is built positionally
+# in this exact order, so divergence would publish values under wrong names.
+TOPO_LABELS: tuple[str, ...] = CHIP_LABELS[2:6]
+
+TPU_KUBELET_ALLOCATABLE_CHIPS = MetricSpec(
+    name="tpu_kubelet_allocatable_chips",
+    help="TPU devices the kubelet device plugin reports as allocatable on this node (absent when the kubelet cannot report it).",
+    type=GAUGE,
+    label_names=TOPO_LABELS,
+)
+
+TPU_KUBELET_ALLOCATED_CHIPS = MetricSpec(
+    name="tpu_kubelet_allocated_chips",
+    help="TPU devices currently allocated to pods on this node, per the kubelet.",
+    type=GAUGE,
+    label_names=TOPO_LABELS,
+)
+
 # --- Exporter self-metrics (SURVEY.md §5: tracing/observability) -------------
 
 TPU_EXPORTER_UP = MetricSpec(
@@ -184,6 +204,8 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_ICI_TRANSFERRED_BYTES_TOTAL,
     TPU_POD_CHIP_COUNT,
     TPU_POD_HBM_USED_BYTES,
+    TPU_KUBELET_ALLOCATABLE_CHIPS,
+    TPU_KUBELET_ALLOCATED_CHIPS,
     TPU_EXPORTER_UP,
     TPU_EXPORTER_POLL_DURATION_SECONDS,
     TPU_EXPORTER_POLL_ERRORS_TOTAL,

@@ -1,0 +1,124 @@
+"""One-shot human-readable status — the ``tpu-info`` analog.
+
+``python -m tpu_pod_exporter.status`` samples the same backends the
+exporter daemon uses (same flags/env) and prints a chip table plus per-pod
+rollups. No server, no loop; exits non-zero if the device read fails.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from tpu_pod_exporter.app import build_attribution, build_backend
+from tpu_pod_exporter.attribution import AttributionError
+from tpu_pod_exporter.backend import BackendError
+from tpu_pod_exporter.config import ExporterConfig
+from tpu_pod_exporter.topology import detect_host_topology
+
+
+def fmt_bytes(n: float) -> str:
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(n) < 1024 or unit == "TiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024
+    return f"{n:.1f}TiB"
+
+
+def render_table(rows: list[list[str]], header: list[str]) -> str:
+    widths = [max(len(str(r[i])) for r in [header] + rows) for i in range(len(header))]
+    def line(r):
+        return "  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+    sep = "  ".join("-" * w for w in widths)
+    return "\n".join([line(header), sep] + [line(r) for r in rows])
+
+
+def main(argv=None) -> int:
+    cfg = ExporterConfig.from_args(argv)
+    topo = detect_host_topology(
+        accelerator=cfg.accelerator, slice_name=cfg.slice_name,
+        host=cfg.node_name, worker_id=cfg.worker_id,
+    )
+    backend = build_backend(cfg)
+    attribution = build_attribution(cfg)
+    try:
+        return _run(cfg, topo, backend, attribution)
+    finally:
+        backend.close()
+        attribution.close()
+
+
+def _run(cfg, topo, backend, attribution) -> int:
+    try:
+        sample = backend.sample()
+    except BackendError as e:
+        print(f"device read failed: {e}", file=sys.stderr)
+        return 1
+    # Per-chip read problems must be visible even when they leave 0 chips —
+    # "no chips found" and "all chip reads failed" are different diagnoses.
+    for err in sample.partial_errors:
+        print(f"warning: {err}", file=sys.stderr)
+    try:
+        owner_map = attribution.snapshot().by_device_id(cfg.resource_name)
+    except AttributionError as e:
+        print(f"(attribution unavailable: {e})", file=sys.stderr)
+        owner_map = {}
+
+    if topo.accelerator:
+        st = topo.slice_topology
+        extra = (
+            f"  ({st.total_chips} chips / {st.num_hosts} hosts slice-wide)"
+            if st.total_chips else ""
+        )
+        print(f"accelerator: {topo.accelerator}{extra}")
+        if topo.worker_id or topo.slice_name:
+            print(f"slice: {topo.slice_name or '-'}  worker: {topo.worker_id or '-'}  host: {topo.host}")
+        print()
+
+    if not sample.chips:
+        print("no TPU chips found on this host")
+        return 0
+
+    rows = []
+    pods: dict[tuple[str, str], list[float]] = {}
+    for chip in sample.chips:
+        owner = None
+        for did in chip.info.device_ids:
+            owner = owner_map.get(did)
+            if owner:
+                break
+        duty = (
+            f"{chip.tensorcore_duty_cycle_percent:.1f}%"
+            if chip.tensorcore_duty_cycle_percent is not None
+            else "-"
+        )
+        pct = (
+            f"{100 * chip.hbm_used_bytes / chip.hbm_total_bytes:.1f}%"
+            if chip.hbm_total_bytes
+            else "-"
+        )
+        rows.append([
+            chip.info.chip_id,
+            chip.info.device_path or "-",
+            f"{fmt_bytes(chip.hbm_used_bytes)}/{fmt_bytes(chip.hbm_total_bytes)}",
+            pct,
+            duty,
+            f"{owner.namespace}/{owner.pod}" if owner else "-",
+        ])
+        if owner:
+            agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
+            agg[0] += 1
+            agg[1] += chip.hbm_used_bytes
+    print(render_table(rows, ["chip", "device", "hbm", "hbm%", "duty", "pod"]))
+
+    if pods:
+        print()
+        pod_rows = [
+            [f"{ns}/{pod}", int(n), fmt_bytes(hbm)]
+            for (ns, pod), (n, hbm) in sorted(pods.items())
+        ]
+        print(render_table(pod_rows, ["pod", "chips", "hbm used"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
