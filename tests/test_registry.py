@@ -140,6 +140,55 @@ class TestSnapshotStore:
         assert snap._text is not None
 
 
+class TestSnapshotStoreConcurrency:
+    def test_swap_current_race(self):
+        """All cross-thread state is one locked reference (SURVEY.md §5 race
+        strategy): hammer swap() and current() from threads; every observed
+        snapshot must be complete and internally consistent."""
+        import threading
+
+        store = SnapshotStore()
+        stop = threading.Event()
+        failures: list[str] = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                i += 1
+                b = SnapshotBuilder()
+                # generation encoded in both value and series: a torn
+                # snapshot would disagree with itself
+                b.add(G, i, (str(i), "x"))
+                b.add(PLAIN, i)
+                store.swap(b.build())
+
+        def reader():
+            while not stop.is_set():
+                snap = store.current()
+                text = snap.encode()
+                if snap.series_count == 0:
+                    continue
+                plain = snap.value("test_plain")
+                gen = int(plain)
+                if snap.value("test_gauge", (str(gen), "x")) != gen:
+                    failures.append(f"torn snapshot at gen {gen}")
+                if text != snap.encode():  # cached render must be stable
+                    failures.append("encode not stable")
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        import time
+
+        time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not failures, failures[:5]
+
+
 class TestCounterStore:
     def test_inc(self):
         c = CounterStore()

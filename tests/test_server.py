@@ -89,6 +89,41 @@ class TestEndpoints:
         assert status == 200 and b"tpu-pod-exporter" in body
 
 
+class TestLivenessStaleness:
+    def test_healthz_trips_when_snapshot_goes_stale(self):
+        import time
+
+        from tpu_pod_exporter.metrics.registry import SnapshotBuilder
+
+        store = SnapshotStore()
+        server = MetricsServer(store, host="127.0.0.1", port=0, health_max_age_s=0.2)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            status, _, _ = get(base + "/healthz")
+            assert status == 200  # no snapshot yet: startup, not a stall
+            b = SnapshotBuilder()
+            b.add(MetricSpec(name="m", help="h"), 1)
+            store.swap(b.build())
+            status, _, _ = get(base + "/healthz")
+            assert status == 200
+            time.sleep(0.4)  # poll "wedges": no further swaps
+            status, _, body = get(base + "/healthz")
+            assert status == 503
+            assert b"poll stalled" in body
+            store.swap(b.build())  # poll recovers
+            status, _, _ = get(base + "/healthz")
+            assert status == 200
+        finally:
+            server.stop()
+
+
+class TestLifecycle:
+    def test_stop_before_start_does_not_deadlock(self):
+        server = MetricsServer(SnapshotStore(), host="127.0.0.1", port=0)
+        server.stop()  # must release the port without hanging
+
+
 class TestPortConflict:
     def test_second_bind_fails_loudly(self):
         store = SnapshotStore()

@@ -147,8 +147,15 @@ class ExporterApp:
             legacy_metrics=cfg.legacy_metrics,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
+        # Liveness trips when the poll thread stops swapping snapshots
+        # (wedged device runtime): generous multiple of the interval so slow
+        # polls don't flap, floored for sub-second intervals.
         self.server = MetricsServer(
-            self.store, host=cfg.host, port=cfg.port, debug_vars=self._debug_vars
+            self.store,
+            host=cfg.host,
+            port=cfg.port,
+            debug_vars=self._debug_vars,
+            health_max_age_s=max(10.0 * cfg.interval_s, 10.0),
         )
 
     def _debug_vars(self) -> dict:

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import logging
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_pod_exporter.metrics import SnapshotStore
@@ -34,6 +35,11 @@ class _Handler(BaseHTTPRequestHandler):
     # set by server factory
     store: SnapshotStore
     debug_vars = None  # optional callable -> dict
+    # /healthz fails when the newest snapshot is older than this (0 = never).
+    # A poll thread wedged inside a hung device runtime stops swapping
+    # snapshots; liveness must catch that so kubelet restarts the pod —
+    # serving stale bytes forever would look "up" while monitoring nothing.
+    health_max_age_s: float = 0.0
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
@@ -53,7 +59,18 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
         elif path == "/healthz":
-            self._serve_text(200, b"ok\n")
+            snap = self.store.current()
+            if (
+                self.health_max_age_s > 0
+                and snap.timestamp > 0
+                and time.time() - snap.timestamp > self.health_max_age_s
+            ):
+                age = time.time() - snap.timestamp
+                self._serve_text(
+                    503, f"poll stalled: last snapshot {age:.1f}s old\n".encode()
+                )
+            else:
+                self._serve_text(200, b"ok\n")
         elif path == "/readyz":
             snap = self.store.current()
             if snap.timestamp > 0:
@@ -113,11 +130,16 @@ class MetricsServer:
         host: str = "0.0.0.0",
         port: int = 8000,
         debug_vars=None,
+        health_max_age_s: float = 0.0,
     ) -> None:
         handler = type(
             "BoundHandler",
             (_Handler,),
-            {"store": store, "debug_vars": staticmethod(debug_vars) if debug_vars else None},
+            {
+                "store": store,
+                "debug_vars": staticmethod(debug_vars) if debug_vars else None,
+                "health_max_age_s": health_max_age_s,
+            },
         )
         self._httpd = _Server((host, port), handler)
         self._thread: threading.Thread | None = None
@@ -136,7 +158,10 @@ class MetricsServer:
         self._thread.start()
 
     def stop(self) -> None:
-        self._httpd.shutdown()
+        if self._thread is not None:
+            # shutdown() blocks until serve_forever acknowledges — calling it
+            # on a never-started server would deadlock, so gate on the thread.
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread is not None:
             self._thread.join(timeout=5.0)
