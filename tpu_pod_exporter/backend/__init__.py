@@ -32,7 +32,7 @@ class BackendError(RuntimeError):
     """A device-telemetry read failed; the poll should degrade, not die."""
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ChipInfo:
     """Static identity of one local TPU chip.
 
@@ -51,7 +51,7 @@ class ChipInfo:
             object.__setattr__(self, "device_ids", (str(self.chip_id),))
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class IciLinkSample:
     """One inter-chip-interconnect link's cumulative traffic counter."""
 
@@ -59,7 +59,7 @@ class IciLinkSample:
     transferred_bytes_total: float # monotonic since runtime start
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class ChipSample:
     """One chip's telemetry at one instant."""
 
@@ -70,7 +70,7 @@ class ChipSample:
     ici_links: tuple[IciLinkSample, ...] = ()
 
 
-@dataclass(frozen=True)
+@dataclass(frozen=True, slots=True)
 class HostSample:
     """All local chips' telemetry from one backend read."""
 

@@ -193,7 +193,6 @@ class Collector:
             b.declare(schema.LEGACY_POD_MEMORY_USAGE)
             b.declare(schema.LEGACY_POD_MEMORY_PERC_USAGE)
 
-        live_counter_keys: set[tuple[str, tuple[str, ...]]] = set()
         pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used, hbm_total]
         ici_now: dict[tuple[str, str], float] = {}
 
@@ -202,14 +201,25 @@ class Collector:
             if self._prev_ici_at is not None:
                 dt = max(now_mono - self._prev_ici_at, 1e-9)
             ici_name = schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name
-            observe_total = self._counters.observe_total
+            cvals, craw = self._counters.maps()
+            # Direct samples-dict handles: one dict store per series instead
+            # of a full add() (family lookup + shape checks) — at 256 chips ×
+            # ~16 series × 1 s that overhead is the largest publish cost.
+            hbm_used_s = b.series(schema.TPU_HBM_USED_BYTES)
+            hbm_total_s = b.series(schema.TPU_HBM_TOTAL_BYTES)
+            hbm_pct_s = b.series(schema.TPU_HBM_USED_PERCENT)
+            duty_s = b.series(schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT)
+            ici_total_s = b.series(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL)
+            ici_bw_s = b.series(schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND)
+            hbm_pct = schema.hbm_used_percent
+            prev_ici = self._prev_ici_totals
             for chip in host_sample.chips:
                 owner = None
                 for did in chip.info.device_ids:
                     owner = device_owner.get(did)
                     if owner is not None:
                         break
-                # Tuple fast path, pre-ordered to CHIP_LABELS.
+                # Pre-ordered to CHIP_LABELS.
                 chip_tuple = (
                     str(chip.info.chip_id),
                     chip.info.device_path,
@@ -218,35 +228,37 @@ class Collector:
                     owner.namespace if owner else "",
                     owner.container if owner else "",
                 )
-                b.add(schema.TPU_HBM_USED_BYTES, chip.hbm_used_bytes, chip_tuple)
-                b.add(schema.TPU_HBM_TOTAL_BYTES, chip.hbm_total_bytes, chip_tuple)
-                b.add(
-                    schema.TPU_HBM_USED_PERCENT,
-                    schema.hbm_used_percent(chip.hbm_used_bytes, chip.hbm_total_bytes),
-                    chip_tuple,
-                )
+                used = chip.hbm_used_bytes
+                total_b = chip.hbm_total_bytes
+                hbm_used_s[chip_tuple] = used
+                hbm_total_s[chip_tuple] = total_b
+                hbm_pct_s[chip_tuple] = hbm_pct(used, total_b)
                 if chip.tensorcore_duty_cycle_percent is not None:
-                    b.add(
-                        schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
-                        chip.tensorcore_duty_cycle_percent,
-                        chip_tuple,
-                    )
+                    duty_s[chip_tuple] = chip.tensorcore_duty_cycle_percent
 
                 for link in chip.ici_links:
                     lv = chip_tuple + (link.link,)  # ICI_LABELS ordering
-                    total = observe_total(ici_name, lv, link.transferred_bytes_total)
-                    live_counter_keys.add((ici_name, lv))
-                    b.add(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL, total, lv)
+                    # Inlined CounterStore.observe_total (see its docstring):
+                    # fold the absolute device counter monotonically.
+                    key = (ici_name, lv)
+                    raw = link.transferred_bytes_total
+                    prev_raw = craw.get(key)
+                    if prev_raw is None:
+                        total = cvals.setdefault(key, raw if raw >= 0 else 0.0)
+                    else:
+                        delta = raw - prev_raw
+                        if delta > 0:
+                            total = cvals[key] = cvals.get(key, 0.0) + delta
+                        else:
+                            total = cvals.get(key, 0.0)
+                    craw[key] = raw
+                    ici_total_s[lv] = total
 
-                    rate_key = (str(chip.info.chip_id), link.link)
+                    rate_key = (chip_tuple[0], link.link)
                     ici_now[rate_key] = total
-                    prev = self._prev_ici_totals.get(rate_key)
+                    prev = prev_ici.get(rate_key)
                     if dt is not None and prev is not None:
-                        b.add(
-                            schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
-                            max(total - prev, 0.0) / dt,
-                            lv,
-                        )
+                        ici_bw_s[lv] = max(total - prev, 0.0) / dt
 
                 if owner is not None:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
@@ -322,7 +334,9 @@ class Collector:
         # the devices this poll: pruning on a failed read would wipe ICI
         # counter state and make the exported counters regress on recovery.
         if host_sample is not None:
-            keep = set(live_counter_keys)
+            # This poll's live ICI series are exactly ici_total_s's keys.
+            ici_name = schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name
+            keep = {(ici_name, lv) for lv in ici_total_s}
             for name in (
                 schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name,
                 schema.TPU_EXPORTER_POLLS_TOTAL.name,
@@ -333,7 +347,7 @@ class Collector:
 
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))
-        self._store.swap(b.build(timestamp=self._wallclock()))
+        self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
 
     def close(self) -> None:
         self._backend.close()

@@ -29,6 +29,28 @@ def render_lines(prefixes: list[bytes], values: list[float]) -> bytes | None:
     return buf.raw[:written]
 
 
+def render_layout(layout, values) -> bytes | None:
+    """Render one family via its :class:`FamilyLayout`, reusing the ctypes
+    pointer array across polls (building it is the per-call cost of
+    ``render_lines``; the prefixes themselves are stable between churn
+    events). ``values`` is an ``array('d')`` — passed to C by buffer, no
+    per-element marshalling. None → caller falls back to the Python
+    formatter."""
+    lib = nativelib.load()
+    if lib is None or not layout.prefixes:
+        return None
+    n = len(layout.prefixes)
+    if layout.native_arr is None:
+        layout.native_arr = (ctypes.c_char_p * n)(*layout.prefixes)
+    arr_v = (ctypes.c_double * n).from_buffer(values)
+    cap = layout.prefix_total + 32 * n
+    buf = ctypes.create_string_buffer(cap)
+    written = lib.tpumon_render(layout.native_arr, arr_v, n, buf, cap)
+    if written < 0:
+        return None
+    return ctypes.string_at(buf, written)
+
+
 def load():
     """Kept for tests: the shared library handle (or None)."""
     return nativelib.load()

@@ -27,6 +27,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+from array import array
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Sequence
 
@@ -116,13 +117,46 @@ class _Family:
 def render_prefix(spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
     """The `metric{label="…"}` part of one exposition line — the single
     source of truth for both the cached and uncached render paths."""
-    if not spec.label_names:
+    if not spec.label_names and not lvs:
         return spec.name.encode()
+    if len(lvs) != len(spec.label_names):
+        raise ValueError(
+            f"{spec.name}: got {len(lvs)} label values, "
+            f"want {len(spec.label_names)}"
+        )
+    for lv in lvs:
+        if type(lv) is not str:
+            raise TypeError(f"{spec.name}: label value {lv!r} is not str")
     pairs = ",".join(
         f'{ln}="{escape_label_value(lv)}"'
         for ln, lv in zip(spec.label_names, lvs)
     )
     return f"{spec.name}{{{pairs}}}".encode()
+
+
+class FamilyLayout:
+    """One family's frozen series order plus everything derivable from it.
+
+    Between churn events the key sequence of a family is identical poll to
+    poll, so the per-series prefixes, the ctypes pointer array the native
+    renderer needs, and even the fully rendered text block (when the values
+    did not change either — HBM totals, chip counts, info series) can all be
+    reused byte-for-byte. Mutated only by the poll thread (inside
+    ``Snapshot.encode`` at swap time), never by scrape threads.
+    """
+
+    __slots__ = (
+        "keys", "prefixes", "native_arr", "prefix_total",
+        "last_values", "last_block",
+    )
+
+    def __init__(self, keys: tuple[tuple[str, ...], ...], prefixes: list[bytes]) -> None:
+        self.keys = keys
+        self.prefixes = prefixes
+        self.native_arr = None  # lazily-built ctypes c_char_p array
+        self.prefix_total = sum(map(len, prefixes))
+        self.last_values: list[float] | None = None
+        self.last_block: bytes | None = None
 
 
 class PrefixCache:
@@ -133,10 +167,15 @@ class PrefixCache:
     256 chips. Keyed by (metric name, label values tuple). Bounded: when the
     cache outgrows ``max_entries`` it is cleared wholesale (churned-away
     label sets would otherwise accumulate forever).
+
+    Also home of the per-family :class:`FamilyLayout` records (the next
+    caching tier up): per-series prefixes answer "how does this label set
+    render", layouts answer "what is this family's exact series order".
     """
 
     def __init__(self, max_entries: int = 65536) -> None:
         self._cache: dict[tuple[str, tuple[str, ...]], bytes] = {}
+        self._layouts: dict[str, FamilyLayout] = {}
         self._max = max_entries
 
     def prefix(self, spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
@@ -148,6 +187,15 @@ class PrefixCache:
                 self._cache.clear()
             self._cache[key] = p
         return p
+
+    def layout(self, spec: MetricSpec, keys: tuple[tuple[str, ...], ...]) -> FamilyLayout:
+        rec = self._layouts.get(spec.name)
+        if rec is not None and rec.keys == keys:
+            return rec
+        pfx = self.prefix
+        rec = FamilyLayout(keys, [pfx(spec, k) for k in keys])
+        self._layouts[spec.name] = rec
+        return rec
 
 
 class SnapshotBuilder:
@@ -188,10 +236,9 @@ class SnapshotBuilder:
         elif fam.spec is not spec and fam.spec != spec:
             raise ValueError(f"conflicting redeclaration of {spec.name}")
         if type(labels) is tuple:
-            # Hot path (the collector): pre-ordered tuple of label values.
-            # Contract: elements are already strings — checked under
-            # assertions (tests), skipped with -O in production.
-            assert all(type(v) is str for v in labels), labels
+            # Hot path: pre-ordered tuple of label values. Contract: elements
+            # are already strings — enforced where it's cheap, at the first
+            # render of a new label set (PrefixCache miss), not per add.
             values = labels
             if len(values) != len(spec.label_names):
                 raise ValueError(
@@ -215,16 +262,35 @@ class SnapshotBuilder:
                 )
         fam.samples[values] = float(value)
 
+    def series(self, spec: MetricSpec) -> dict[tuple[str, ...], float]:
+        """Direct handle on a family's samples dict, for the collector's hot
+        loop: ``series(SPEC)[label_tuple] = value`` is one dict store, vs the
+        per-call family lookup + shape checks of :meth:`add`. Caller contract
+        (same as the tuple fast path of ``add``): keys are pre-ordered tuples
+        of ``str`` matching ``spec.label_names`` — enforced at first render
+        of each new label set."""
+        self.declare(spec)
+        return self._families[spec.name].samples
+
     @property
     def series_count(self) -> int:
         return sum(len(f.samples) for f in self._families.values())
 
-    def build(self, timestamp: float | None = None) -> "Snapshot":
+    def build(self, timestamp: float | None = None, *, transfer: bool = False) -> "Snapshot":
+        """Freeze into a Snapshot. With ``transfer=True`` the family dicts are
+        handed off instead of copied (the builder resets to empty) — for the
+        poll loop, which discards its builder after every poll anyway."""
+        if transfer:
+            families = {n: self._families[n] for n in self._order}
+            self._families = {}
+            self._order = []
+        else:
+            families = {
+                n: _Family(self._families[n].spec, dict(self._families[n].samples))
+                for n in self._order
+            }
         return Snapshot(
-            families={
-                name: _Family(f.spec, dict(f.samples))
-                for name, f in ((n, self._families[n]) for n in self._order)
-            },
+            families=families,
             timestamp=time.time() if timestamp is None else timestamp,
             prefix_cache=self._prefix_cache,
         )
@@ -244,6 +310,7 @@ class Snapshot:
         self._prefix_cache = prefix_cache
         self._text: bytes | None = None
         self._gzipped: bytes | None = None
+        self._gzip_lock = threading.Lock()
 
     @property
     def series_count(self) -> int:
@@ -272,9 +339,15 @@ class Snapshot:
     def encode(self) -> bytes:
         """Prometheus text exposition format (rendered once, then cached).
 
-        Sample lines go through the native renderer (libtpumon) when
-        available; header lines and label escaping stay in Python either
-        way. Both paths produce parser-equivalent output.
+        Called by the poll thread at swap time, so scrapes always see cached
+        bytes. With a PrefixCache attached, rendering is layout-aware: the
+        family's series order is matched against the previous poll's
+        :class:`FamilyLayout`; on a hit, per-series prefix lookups and the
+        ctypes marshalling are skipped, and when the value vector is also
+        unchanged (constant families: HBM totals, chip counts, info) the
+        previous rendered block is reused outright. Sample lines go through
+        the native renderer (libtpumon) when available; both paths produce
+        parser-equivalent output.
         """
         if self._text is not None:
             return self._text
@@ -293,17 +366,26 @@ class Snapshot:
             )
             if not fam.samples:
                 continue
-            prefixes: list[bytes] = []
-            values: list[float] = []
             if cache is not None:
-                pfx = cache.prefix
-                for lvs, value in fam.samples.items():
-                    prefixes.append(pfx(spec, lvs))
-                    values.append(value)
-            else:
-                for lvs, value in fam.samples.items():
-                    prefixes.append(render_prefix(spec, lvs))
-                    values.append(value)
+                layout = cache.layout(spec, tuple(fam.samples))
+                # array('d') packs the value vector at C speed; comparison
+                # against the previous poll's vector is likewise C-level.
+                values = array("d", fam.samples.values())
+                if layout.last_block is not None and layout.last_values == values:
+                    chunks.append(layout.last_block)
+                    continue
+                rendered = native.render_layout(layout, values) if native else None
+                if rendered is None:
+                    rendered = b"".join(
+                        p + b" " + format_value(v).encode() + b"\n"
+                        for p, v in zip(layout.prefixes, values)
+                    )
+                layout.last_values = values
+                layout.last_block = rendered
+                chunks.append(rendered)
+                continue
+            prefixes = [render_prefix(spec, lvs) for lvs in fam.samples]
+            values = list(fam.samples.values())
             rendered = native.render_lines(prefixes, values) if native else None
             if rendered is None:
                 rendered = b"".join(
@@ -315,13 +397,18 @@ class Snapshot:
         return self._text
 
     def encode_gzip(self) -> bytes:
-        """Gzipped exposition, compressed once per poll, not per scrape —
-        Prometheus sends Accept-Encoding: gzip by default, so this IS the
-        production scrape body."""
+        """Gzipped exposition, compressed lazily on the first gzip-accepting
+        scrape of this snapshot (then cached). Compressing eagerly at swap
+        time would cost ~2 ms per poll even when Prometheus scrapes far less
+        often than the 1 s poll interval; lazily, the cost lands once per
+        scraped snapshot. Thread-safe: scrape threads race benignly behind a
+        lock."""
         if self._gzipped is None:
             import gzip
 
-            self._gzipped = gzip.compress(self.encode(), compresslevel=1)
+            with self._gzip_lock:
+                if self._gzipped is None:
+                    self._gzipped = gzip.compress(self.encode(), compresslevel=1)
         return self._gzipped
 
 
@@ -342,8 +429,7 @@ class SnapshotStore:
         self._snapshot: Snapshot = EMPTY_SNAPSHOT
 
     def swap(self, snapshot: Snapshot) -> None:
-        snapshot.encode()       # render once, off the scrape path
-        snapshot.encode_gzip()  # likewise the gzip body
+        snapshot.encode()  # render once, off the scrape path (gzip is lazy)
         with self._lock:
             self._snapshot = snapshot
 
@@ -389,6 +475,12 @@ class CounterStore:
 
     def get(self, name: str, labels: tuple[str, ...]) -> float:
         return self._values.get((name, labels), 0.0)
+
+    def maps(self) -> tuple[dict, dict]:
+        """(values, raw) dicts for hot-path inlined folding. The collector's
+        per-link loop reimplements :meth:`observe_total` against these to
+        avoid ~1.5k function calls per poll — keep the two in sync."""
+        return self._values, self._raw
 
     def items_for(self, name: str) -> list[tuple[tuple[str, ...], float]]:
         return [(k[1], v) for k, v in self._values.items() if k[0] == name]

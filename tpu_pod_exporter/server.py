@@ -89,7 +89,7 @@ class _Handler(BaseHTTPRequestHandler):
         snap = self.store.current()
         headers = [("Content-Type", CONTENT_TYPE)]
         if "gzip" in (self.headers.get("Accept-Encoding") or ""):
-            body = snap.encode_gzip()  # pre-compressed at poll time
+            body = snap.encode_gzip()  # compressed once per snapshot, cached
             headers.append(("Content-Encoding", "gzip"))
         else:
             body = snap.encode()
