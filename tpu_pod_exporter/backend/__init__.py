@@ -25,7 +25,8 @@ the hot loop, ``main.go:119-137``).
 from __future__ import annotations
 
 import abc
-from dataclasses import dataclass, field
+from dataclasses import dataclass
+from typing import NamedTuple
 
 
 class BackendError(RuntimeError):
@@ -51,17 +52,21 @@ class ChipInfo:
             object.__setattr__(self, "device_ids", (str(self.chip_id),))
 
 
-@dataclass(frozen=True, slots=True)
-class IciLinkSample:
-    """One inter-chip-interconnect link's cumulative traffic counter."""
+class IciLinkSample(NamedTuple):
+    """One inter-chip-interconnect link's cumulative traffic counter.
+
+    NamedTuple, not dataclass: backends construct one of these per link per
+    poll (256 chips × 6 links at 1 s), and tuple construction keeps that off
+    the CPU budget — frozen-dataclass ``__init__`` goes through
+    ``object.__setattr__`` per field.
+    """
 
     link: str                      # stable link id, e.g. "0".."5" (3D torus: ±x,±y,±z)
     transferred_bytes_total: float # monotonic since runtime start
 
 
-@dataclass(frozen=True, slots=True)
-class ChipSample:
-    """One chip's telemetry at one instant."""
+class ChipSample(NamedTuple):
+    """One chip's telemetry at one instant. (NamedTuple — see IciLinkSample.)"""
 
     info: ChipInfo
     hbm_used_bytes: float
@@ -70,8 +75,7 @@ class ChipSample:
     ici_links: tuple[IciLinkSample, ...] = ()
 
 
-@dataclass(frozen=True, slots=True)
-class HostSample:
+class HostSample(NamedTuple):
     """All local chips' telemetry from one backend read."""
 
     chips: tuple[ChipSample, ...] = ()

@@ -43,6 +43,8 @@ class FakeChipScript:
     # cumulative bytes per link per poll step
     ici_bytes_per_step: float | Callable[[int], float] = 0.0
 
+    _LINK_IDS = tuple(str(i) for i in range(16))
+
     def _resolve(self, v, step: int) -> float:
         return float(v(step)) if callable(v) else float(v)
 
@@ -51,9 +53,12 @@ class FakeChipScript:
         if self.duty_cycle_percent is not None:
             duty = self._resolve(self.duty_cycle_percent, step)
         per_step = self._resolve(self.ici_bytes_per_step, step)
+        total = per_step * (step + 1)
+        ids = self._LINK_IDS
+        if self.ici_link_count > len(ids):
+            ids = tuple(str(i) for i in range(self.ici_link_count))
         links = tuple(
-            IciLinkSample(link=str(li), transferred_bytes_total=per_step * (step + 1))
-            for li in range(self.ici_link_count)
+            IciLinkSample(ids[li], total) for li in range(self.ici_link_count)
         )
         return ChipSample(
             info=info,

@@ -97,8 +97,29 @@ class Collector:
         )
         self._last_attr: AttributionSnapshot | None = None
         self._last_attr_at: float = 0.0
-        # previous folded ICI totals + read time, for bandwidth rates
-        self._prev_ici_totals: dict[tuple[str, str], float] = {}
+        # (chip_id, owner pod/ns/container) -> (chip label tuple,
+        # {link id -> link label tuple}). Label tuples are invariant between
+        # churn events, so rebuilding + re-interning them per chip per poll
+        # is the main Python cost of publish at 256 chips; cache and reuse.
+        # The cached inner tuples also make the PrefixCache layout comparison
+        # hit its pointer-identity fast path. Bounded: wiped wholesale when
+        # churn outgrows it (entries for dead owners are unreachable after).
+        self._label_cache: dict[tuple, tuple[tuple, dict]] = {}
+        # chip_id -> {link id -> [raw_prev, folded, rate_base, last_seq]}:
+        # per-link monotonic-fold state, deliberately keyed by chip (not by
+        # owner) so counters and rates continue across pod reassignment.
+        # Mutable-list slot access instead of tuple-keyed CounterStore
+        # lookups, which at 1.5k links × ~5 nested-tuple hashes each were
+        # the hottest publish cost. A wiped record re-seeds its counter at
+        # the current raw value, which is ≥ the folded value barring a
+        # device reset in the same instant, so exported counters stay
+        # monotonic.
+        self._chip_state: dict[int, dict[str, list]] = {}
+        # Monotonic publish sequence for polls that carried a device sample;
+        # a link's rate is published only when it was also seen at seq-1
+        # (dt measures exactly that window).
+        self._publish_seq = 0
+        # monotonic time of the previous published device sample, for rates
         self._prev_ici_at: float | None = None
         self.last_stats = PollStats()
 
@@ -194,14 +215,12 @@ class Collector:
             b.declare(schema.LEGACY_POD_MEMORY_PERC_USAGE)
 
         pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used, hbm_total]
-        ici_now: dict[tuple[str, str], float] = {}
 
         if host_sample is not None:
             dt = None
             if self._prev_ici_at is not None:
                 dt = max(now_mono - self._prev_ici_at, 1e-9)
-            ici_name = schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name
-            cvals, craw = self._counters.maps()
+            seq = self._publish_seq = self._publish_seq + 1
             # Direct samples-dict handles: one dict store per series instead
             # of a full add() (family lookup + shape checks) — at 256 chips ×
             # ~16 series × 1 s that overhead is the largest publish cost.
@@ -211,54 +230,89 @@ class Collector:
             duty_s = b.series(schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT)
             ici_total_s = b.series(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL)
             ici_bw_s = b.series(schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND)
-            hbm_pct = schema.hbm_used_percent
-            prev_ici = self._prev_ici_totals
+            label_cache = self._label_cache
+            if len(label_cache) > 4 * len(host_sample.chips) + 64:
+                label_cache.clear()
+            chip_state = self._chip_state
+            if len(chip_state) > 2 * len(host_sample.chips) + 64:
+                # Prune only vanished chips — never live ones. A wholesale
+                # clear would re-seed surviving links' counters at the raw
+                # reading, which regresses the exported counter whenever a
+                # device reset ever happened (folded > raw from then on).
+                live = {c.info.chip_id for c in host_sample.chips}
+                for cid in [cid for cid in chip_state if cid not in live]:
+                    del chip_state[cid]
             for chip in host_sample.chips:
                 owner = None
                 for did in chip.info.device_ids:
                     owner = device_owner.get(did)
                     if owner is not None:
                         break
-                # Pre-ordered to CHIP_LABELS.
-                chip_tuple = (
-                    str(chip.info.chip_id),
-                    chip.info.device_path,
-                    *self._topo_tuple,
+                info = chip.info
+                cache_key = (
+                    info.chip_id,
+                    info.device_path,  # re-enumeration can move a chip_id
                     owner.pod if owner else "",
                     owner.namespace if owner else "",
                     owner.container if owner else "",
                 )
+                cached = label_cache.get(cache_key)
+                if cached is None:
+                    # Pre-ordered to CHIP_LABELS.
+                    cached = (
+                        (
+                            str(info.chip_id),
+                            info.device_path,
+                            *self._topo_tuple,
+                            *cache_key[2:],
+                        ),
+                        {},
+                    )
+                    label_cache[cache_key] = cached
+                chip_tuple, link_tuples = cached
+                link_recs = chip_state.get(info.chip_id)
+                if link_recs is None:
+                    link_recs = chip_state[info.chip_id] = {}
                 used = chip.hbm_used_bytes
                 total_b = chip.hbm_total_bytes
                 hbm_used_s[chip_tuple] = used
                 hbm_total_s[chip_tuple] = total_b
-                hbm_pct_s[chip_tuple] = hbm_pct(used, total_b)
+                # hbm_used_percent inlined (analog of main.go:149-150).
+                hbm_pct_s[chip_tuple] = (
+                    used / total_b * 100.0 if total_b > 0 else 0.0
+                )
                 if chip.tensorcore_duty_cycle_percent is not None:
                     duty_s[chip_tuple] = chip.tensorcore_duty_cycle_percent
 
                 for link in chip.ici_links:
-                    lv = chip_tuple + (link.link,)  # ICI_LABELS ordering
-                    # Inlined CounterStore.observe_total (see its docstring):
-                    # fold the absolute device counter monotonically.
-                    key = (ici_name, lv)
                     raw = link.transferred_bytes_total
-                    prev_raw = craw.get(key)
-                    if prev_raw is None:
-                        total = cvals.setdefault(key, raw if raw >= 0 else 0.0)
-                    else:
-                        delta = raw - prev_raw
-                        if delta > 0:
-                            total = cvals[key] = cvals.get(key, 0.0) + delta
-                        else:
-                            total = cvals.get(key, 0.0)
-                    craw[key] = raw
-                    ici_total_s[lv] = total
-
-                    rate_key = (chip_tuple[0], link.link)
-                    ici_now[rate_key] = total
-                    prev = prev_ici.get(rate_key)
-                    if dt is not None and prev is not None:
-                        ici_bw_s[lv] = max(total - prev, 0.0) / dt
+                    lv = link_tuples.get(link.link)
+                    if lv is None:
+                        lv = link_tuples[link.link] = chip_tuple + (link.link,)  # ICI_LABELS ordering
+                    rec = link_recs.get(link.link)
+                    if rec is None:
+                        # First sighting of this chip+link: seed the monotonic
+                        # fold at the current raw reading
+                        # (CounterStore.observe_total semantics).
+                        folded = raw if raw >= 0 else 0.0
+                        link_recs[link.link] = [raw, folded, folded, seq]
+                        ici_total_s[lv] = folded
+                        continue
+                    raw_prev, folded, rate_base, last_seq = rec
+                    delta = raw - raw_prev
+                    if delta > 0:
+                        folded = rec[1] = folded + delta
+                    rec[0] = raw
+                    ici_total_s[lv] = folded
+                    if dt is not None and last_seq == seq - 1:
+                        # Rounded to whole bytes/s: sub-byte rate precision is
+                        # noise, and integral values take the renderer's fast
+                        # integer path (fractional doubles cost ~1 µs each in
+                        # shortest-round-trip formatting × 1.5k links).
+                        bw = (folded - rate_base) / dt
+                        ici_bw_s[lv] = round(bw) if bw > 0.0 else 0.0
+                    rec[2] = folded
+                    rec[3] = seq
 
                 if owner is not None:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
@@ -267,7 +321,6 @@ class Collector:
                     agg[1] += chip.hbm_used_bytes
                     agg[2] += chip.hbm_total_bytes
 
-            self._prev_ici_totals = ici_now
             self._prev_ici_at = now_mono
 
         legacy_rollup: dict[str, list[float]] = {}
@@ -329,21 +382,10 @@ class Collector:
         )
         b.add(schema.TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS, self._wallclock())
 
-        # Prune counter state for vanished chips/links (keep self-metric and
-        # error counters — they are node-lifetime). Only when we actually saw
-        # the devices this poll: pruning on a failed read would wipe ICI
-        # counter state and make the exported counters regress on recovery.
-        if host_sample is not None:
-            # This poll's live ICI series are exactly ici_total_s's keys.
-            ici_name = schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL.name
-            keep = {(ici_name, lv) for lv in ici_total_s}
-            for name in (
-                schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name,
-                schema.TPU_EXPORTER_POLLS_TOTAL.name,
-            ):
-                for lv, _ in self._counters.items_for(name):
-                    keep.add((name, lv))
-            self._counters.prune(keep)
+        # ICI counter state lives in self._chip_state (pruned above when it
+        # outgrows its bound: vanished chips only, never live ones).
+        # CounterStore now holds only the node-lifetime self-metric
+        # counters, so there is nothing to prune per poll.
 
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))

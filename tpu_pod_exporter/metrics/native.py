@@ -42,10 +42,17 @@ def render_layout(layout, values) -> bytes | None:
     n = len(layout.prefixes)
     if layout.native_arr is None:
         layout.native_arr = (ctypes.c_char_p * n)(*layout.prefixes)
+        layout.plens_arr = (ctypes.c_int * n)(*map(len, layout.prefixes))
     arr_v = (ctypes.c_double * n).from_buffer(values)
     cap = layout.prefix_total + 32 * n
-    buf = ctypes.create_string_buffer(cap)
-    written = lib.tpumon_render(layout.native_arr, arr_v, n, buf, cap)
+    buf = layout.out_buf
+    if buf is None or len(buf) < cap:
+        # Reused across polls: create_string_buffer would malloc + zero-fill
+        # hundreds of KB per family per poll on the big (per-link) families.
+        buf = layout.out_buf = ctypes.create_string_buffer(cap)
+    written = lib.tpumon_render2(
+        layout.native_arr, layout.plens_arr, arr_v, n, buf, len(buf)
+    )
     if written < 0:
         return None
     return ctypes.string_at(buf, written)

@@ -146,17 +146,19 @@ class FamilyLayout:
     """
 
     __slots__ = (
-        "keys", "prefixes", "native_arr", "prefix_total",
-        "last_values", "last_block",
+        "keys", "prefixes", "native_arr", "plens_arr", "prefix_total",
+        "last_values", "last_block", "out_buf",
     )
 
     def __init__(self, keys: tuple[tuple[str, ...], ...], prefixes: list[bytes]) -> None:
         self.keys = keys
         self.prefixes = prefixes
         self.native_arr = None  # lazily-built ctypes c_char_p array
+        self.plens_arr = None   # lazily-built ctypes c_int array of prefix lengths
         self.prefix_total = sum(map(len, prefixes))
         self.last_values: list[float] | None = None
         self.last_block: bytes | None = None
+        self.out_buf = None  # reused ctypes render buffer (native path)
 
 
 class PrefixCache:
@@ -475,12 +477,6 @@ class CounterStore:
 
     def get(self, name: str, labels: tuple[str, ...]) -> float:
         return self._values.get((name, labels), 0.0)
-
-    def maps(self) -> tuple[dict, dict]:
-        """(values, raw) dicts for hot-path inlined folding. The collector's
-        per-link loop reimplements :meth:`observe_total` against these to
-        avoid ~1.5k function calls per poll — keep the two in sync."""
-        return self._values, self._raw
 
     def items_for(self, name: str) -> list[tuple[tuple[str, ...], float]]:
         return [(k[1], v) for k, v in self._values.items() if k[0] == name]

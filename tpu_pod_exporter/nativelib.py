@@ -17,7 +17,7 @@ from pathlib import Path
 
 log = logging.getLogger("tpu_pod_exporter.nativelib")
 
-ABI_VERSION = 1
+ABI_VERSION = 2
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -59,6 +59,15 @@ def load() -> ctypes.CDLL | None:
                 lib.tpumon_render.restype = ctypes.c_long
                 lib.tpumon_render.argtypes = [
                     ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_double),
+                    ctypes.c_long,
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                ]
+                lib.tpumon_render2.restype = ctypes.c_long
+                lib.tpumon_render2.argtypes = [
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_int),
                     ctypes.POINTER(ctypes.c_double),
                     ctypes.c_long,
                     ctypes.c_char_p,
