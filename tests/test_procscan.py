@@ -1,0 +1,265 @@
+"""Procfs process↔chip attribution (SURVEY.md §2.6 inversion).
+
+The reference harvests *container-namespace* PIDs via ``kubectl exec … ps``
+and joins them against NVML *host* PIDs (broken by construction). Here the
+scan reads ``/proc/<pid>/fd`` host-side over a synthetic proc tree — the
+symlink targets never need to exist, so these tests run with zero devices.
+"""
+
+import os
+
+import pytest
+
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+from tpu_pod_exporter.collector import Collector
+from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.procscan import DeviceHolder, ProcScanner, parse_cgroup_identity
+from tpu_pod_exporter.topology import HostTopology
+
+UID = "3a61f333-1234-5678-9abc-def012345678"
+CID = "0123456789abcdef0123456789abcdef0123456789abcdef0123456789abcdef"
+
+CGROUP_V2 = (
+    "0::/kubepods.slice/kubepods-burstable.slice/"
+    f"kubepods-burstable-pod{UID.replace('-', '_')}.slice/"
+    f"cri-containerd-{CID}.scope\n"
+)
+CGROUP_V1 = (
+    "12:memory:/kubepods/burstable/pod" + UID + "/" + CID + "\n"
+    "11:cpu,cpuacct:/kubepods/burstable/pod" + UID + "/" + CID + "\n"
+)
+CGROUP_DOCKER = (
+    "0::/kubepods.slice/kubepods-pod" + UID.replace("-", "_") + ".slice/"
+    "docker-" + CID + ".scope\n"
+)
+CGROUP_NON_POD = "0::/user.slice/user-0.slice/session-1.scope\n"
+
+
+def add_proc(root, pid, fds, comm="train_worker", cgroup=CGROUP_V2):
+    d = root / str(pid)
+    (d / "fd").mkdir(parents=True)
+    for i, target in enumerate(fds):
+        os.symlink(target, d / "fd" / str(3 + i))
+    (d / "comm").write_text(comm + "\n")
+    (d / "cgroup").write_text(cgroup)
+
+
+class TestParseCgroupIdentity:
+    def test_v2_systemd(self):
+        assert parse_cgroup_identity(CGROUP_V2) == (UID, CID)
+
+    def test_v1_cgroupfs(self):
+        assert parse_cgroup_identity(CGROUP_V1) == (UID, CID)
+
+    def test_docker_scope(self):
+        assert parse_cgroup_identity(CGROUP_DOCKER) == (UID, CID)
+
+    def test_non_pod_process(self):
+        assert parse_cgroup_identity(CGROUP_NON_POD) == ("", "")
+
+    def test_empty(self):
+        assert parse_cgroup_identity("") == ("", "")
+
+    def test_pod_without_container_component(self):
+        text = "0::/kubepods.slice/kubepods-pod" + UID.replace("-", "_") + ".slice\n"
+        assert parse_cgroup_identity(text) == (UID, "")
+
+
+class TestFullScan:
+    def test_finds_holders_with_identity(self, tmp_path):
+        add_proc(tmp_path, 100, ["/dev/accel0", "/dev/accel1"])
+        add_proc(tmp_path, 200, ["/dev/null", "/tmp/log"])  # not a holder
+        (tmp_path / "self").mkdir()  # non-numeric entries are skipped
+        s = ProcScanner(proc_root=str(tmp_path))
+        holders = s.scan()
+        assert holders == (
+            DeviceHolder(100, "train_worker", "/dev/accel0", UID, CID),
+            DeviceHolder(100, "train_worker", "/dev/accel1", UID, CID),
+        )
+
+    def test_duplicate_fds_to_one_device_dedupe(self, tmp_path):
+        add_proc(tmp_path, 50, ["/dev/accel2", "/dev/accel2", "/dev/accel2"])
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert [h.device_path for h in holders] == ["/dev/accel2"]
+
+    def test_vfio_paths_match(self, tmp_path):
+        add_proc(tmp_path, 60, ["/dev/vfio/17"], cgroup=CGROUP_NON_POD)
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert holders == (DeviceHolder(60, "train_worker", "/dev/vfio/17"),)
+
+    def test_unreadable_fd_table_skips_process(self, tmp_path):
+        d = tmp_path / "300"
+        d.mkdir()
+        (d / "fd").write_text("not a dir")  # listdir → NotADirectoryError
+        add_proc(tmp_path, 301, ["/dev/accel0"])
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert [h.pid for h in holders] == [301]
+
+    def test_missing_proc_root_is_empty(self, tmp_path):
+        s = ProcScanner(proc_root=str(tmp_path / "nope"))
+        assert s.scan() == ()
+
+    def test_sorted_by_pid(self, tmp_path):
+        add_proc(tmp_path, 900, ["/dev/accel1"])
+        add_proc(tmp_path, 80, ["/dev/accel0"])
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert [h.pid for h in holders] == [80, 900]
+
+
+class TestIncrementalScan:
+    def test_new_holder_appears_after_full_scan_interval(self, tmp_path):
+        add_proc(tmp_path, 100, ["/dev/accel0"])
+        s = ProcScanner(proc_root=str(tmp_path), full_scan_every=3)
+        assert len(s.scan()) == 1  # full scan #1
+        add_proc(tmp_path, 101, ["/dev/accel1"])
+        # Verify-only window: cached set unchanged, new pid not yet visible.
+        assert len(s.scan()) == 1
+        assert len(s.scan()) == 1
+        assert len(s.scan()) == 1  # 3rd verify exhausts the window
+        assert len(s.scan()) == 2  # next full scan picks up pid 101
+        assert s.full_scans == 2
+
+    def test_departed_holder_triggers_immediate_rescan(self, tmp_path):
+        import shutil
+
+        add_proc(tmp_path, 100, ["/dev/accel0"])
+        add_proc(tmp_path, 101, ["/dev/accel1"])
+        s = ProcScanner(proc_root=str(tmp_path), full_scan_every=1000)
+        assert len(s.scan()) == 2
+        shutil.rmtree(tmp_path / "100")  # chip 0 freed
+        holders = s.scan()  # verify notices, falls through to full scan
+        assert [h.pid for h in holders] == [101]
+        assert s.full_scans == 2
+
+    def test_empty_holder_set_is_also_cached(self, tmp_path):
+        # Idle node (chips present, nothing holding them): the verify window
+        # must apply to the empty result too, not degenerate into a full
+        # /proc walk every poll.
+        tmp_path.mkdir(exist_ok=True)
+        s = ProcScanner(proc_root=str(tmp_path), full_scan_every=4)
+        for _ in range(9):
+            assert s.scan() == ()
+        assert s.full_scans == 2  # polls 1 and 6, not all 9
+
+    def test_cached_path_costs_only_holder_reads(self, tmp_path):
+        add_proc(tmp_path, 100, ["/dev/accel0"])
+        s = ProcScanner(proc_root=str(tmp_path), full_scan_every=5)
+        s.scan()
+        s.scan()
+        s.scan()
+        assert s.full_scans == 1
+        assert s.verify_scans == 2
+
+
+def make_collector(store, scanner, legacy=False, chips=2):
+    backend = FakeBackend(
+        chips=chips,
+        script=FakeChipScript(hbm_total_bytes=100.0, hbm_used_bytes=25.0),
+    )
+    attr = FakeAttribution(
+        [simple_allocation("train-0", ["0", "1"], namespace="ml")]
+    )
+    topo = HostTopology(accelerator="v4-8", slice_name="s0", host="h0", worker_id="0")
+    return Collector(
+        backend, attr, store, topology=topo,
+        process_scanner=scanner, legacy_metrics=legacy,
+    )
+
+
+def process_labels(chip_id, pid, comm="train_worker", pod_uid=UID,
+                   pod="train-0", namespace="ml", container="main"):
+    return {
+        "chip_id": str(chip_id),
+        "device_path": f"/dev/accel{chip_id}",
+        "accelerator": "v4-8",
+        "slice_name": "s0",
+        "host": "h0",
+        "worker_id": "0",
+        "pod": pod,
+        "namespace": namespace,
+        "container": container,
+        "pid": str(pid),
+        "comm": comm,
+        "pod_uid": pod_uid,
+    }
+
+
+class TestCollectorIntegration:
+    def test_chip_process_info_series(self, tmp_path):
+        add_proc(tmp_path, 4242, ["/dev/accel0"])
+        store = SnapshotStore()
+        c = make_collector(store, ProcScanner(proc_root=str(tmp_path)))
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_chip_process_info", process_labels(0, 4242)) == 1.0
+        # Chip 1 has no holder — no series for it.
+        assert snap.value("tpu_chip_process_info", process_labels(1, 4242)) is None
+        assert c.last_stats.process_scan_s >= 0.0
+
+    def test_multiple_holders_one_chip(self, tmp_path):
+        add_proc(tmp_path, 10, ["/dev/accel0"])
+        add_proc(tmp_path, 11, ["/dev/accel0"])
+        store = SnapshotStore()
+        c = make_collector(store, ProcScanner(proc_root=str(tmp_path)))
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_chip_process_info", process_labels(0, 10)) == 1.0
+        assert snap.value("tpu_chip_process_info", process_labels(0, 11)) == 1.0
+
+    def test_legacy_pid_label_uses_primary_holder(self, tmp_path):
+        add_proc(tmp_path, 500, ["/dev/accel0", "/dev/accel1"])
+        store = SnapshotStore()
+        c = make_collector(store, ProcScanner(proc_root=str(tmp_path)), legacy=True)
+        c.poll_once()
+        snap = store.current()
+        # Both chips held by pid 500: one legacy series {pid="500", pod}.
+        assert snap.value(
+            "pod_gpu_memory_usage", {"pid": "500", "pod": "train-0"}
+        ) == 50.0
+        assert snap.value(
+            "docker_gpu_memory_perc_usage", {"pid": "500", "pod": "train-0"}
+        ) == 25.0
+
+    def test_legacy_pid_empty_without_holders(self, tmp_path):
+        store = SnapshotStore()
+        c = make_collector(store, ProcScanner(proc_root=str(tmp_path)), legacy=True)
+        c.poll_once()
+        snap = store.current()
+        assert snap.value(
+            "pod_gpu_memory_usage", {"pid": "", "pod": "train-0"}
+        ) == 50.0
+
+    def test_scanner_failure_is_contained(self):
+        class BoomScanner:
+            def scan(self):
+                raise RuntimeError("boom")
+
+        store = SnapshotStore()
+        c = make_collector(store, BoomScanner())
+        stats = c.poll_once()
+        assert stats.ok  # device read fine; scan failure degrades only
+        assert "process_scan" in stats.errors
+        snap = store.current()
+        assert snap.value(
+            "tpu_exporter_poll_errors_total", {"source": "process_scan"}
+        ) == 1.0
+        # Chip metrics unaffected.
+        assert snap.value("tpu_exporter_up") == 1.0
+
+    def test_phase_timing_published(self, tmp_path):
+        store = SnapshotStore()
+        c = make_collector(store, ProcScanner(proc_root=str(tmp_path)))
+        c.poll_once()
+        snap = store.current()
+        assert (
+            snap.value("tpu_exporter_poll_duration_seconds", {"phase": "process_scan"})
+            is not None
+        )
+
+    def test_no_scanner_means_no_family(self):
+        store = SnapshotStore()
+        c = make_collector(store, None)
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "tpu_chip_process_info" not in text

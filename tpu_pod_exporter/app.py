@@ -137,6 +137,15 @@ class ExporterApp:
             host=cfg.node_name,
             worker_id=cfg.worker_id,
         )
+        scanner = None
+        if cfg.process_metrics:
+            from tpu_pod_exporter.procscan import ProcScanner
+
+            scanner = ProcScanner(
+                proc_root=cfg.proc_root,
+                full_scan_every=cfg.process_full_scan_every,
+            )
+        self.process_scanner = scanner
         self.collector = Collector(
             backend=self.backend,
             attribution=self.attribution,
@@ -145,6 +154,7 @@ class ExporterApp:
             resource_name=cfg.resource_name,
             attribution_max_stale_s=cfg.attribution_max_stale_s,
             legacy_metrics=cfg.legacy_metrics,
+            process_scanner=scanner,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -163,7 +173,7 @@ class ExporterApp:
         tracing beyond what fits in Prometheus gauges)."""
         stats = self.collector.last_stats
         snap = self.store.current()  # bind once: series + age must agree
-        return {
+        out = {
             "config": {
                 "interval_s": self.cfg.interval_s,
                 "backend": getattr(self.backend, "name", "?"),
@@ -175,6 +185,7 @@ class ExporterApp:
                 "errors": list(stats.errors),
                 "device_read_s": stats.device_read_s,
                 "attribution_s": stats.attribution_s,
+                "process_scan_s": stats.process_scan_s,
                 "join_s": stats.join_s,
                 "publish_s": stats.publish_s,
                 "total_s": stats.total_s,
@@ -183,6 +194,12 @@ class ExporterApp:
             "series": snap.series_count,
             "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
         }
+        if self.process_scanner is not None:
+            out["process_scanner"] = {
+                "full_scans": self.process_scanner.full_scans,
+                "verify_scans": self.process_scanner.verify_scans,
+            }
+        return out
 
     @property
     def port(self) -> int:

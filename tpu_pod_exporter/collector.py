@@ -52,6 +52,7 @@ class PollStats:
 
     device_read_s: float = 0.0
     attribution_s: float = 0.0
+    process_scan_s: float = 0.0
     join_s: float = 0.0
     publish_s: float = 0.0
     total_s: float = 0.0
@@ -69,11 +70,13 @@ class Collector:
         resource_name: str = TPU_RESOURCE_NAME,
         attribution_max_stale_s: float = 30.0,
         legacy_metrics: bool = False,
+        process_scanner=None,
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
         self._backend = backend
         self._attribution = attribution
+        self._process_scanner = process_scanner
         self._store = store
         self._topology = topology or HostTopology()
         self._resource_name = resource_name
@@ -149,6 +152,17 @@ class Collector:
         attr = self._read_attribution(errors)
         ta1 = self._clock()
 
+        # Phase 2b: process scan (the honest analog of the reference's PID
+        # harvest, main.go:92-109 — local procfs instead of kubectl exec).
+        holders = None
+        if self._process_scanner is not None:
+            try:
+                holders = self._process_scanner.scan()
+            except Exception as e:  # noqa: BLE001 — never die in the loop
+                errors.append("process_scan")
+                self._rlog.warning("process_scan", "process scan failed: %s", e)
+        tps1 = self._clock()
+
         # Phase 3: join (replaces main.go:141-154).
         device_owner = attr.by_device_id(self._resource_name) if attr else {}
         allocatable = attr.allocatable_device_ids if attr else None
@@ -167,12 +181,14 @@ class Collector:
         stats = PollStats(
             device_read_s=td1 - td0,
             attribution_s=ta1 - td1,
-            join_s=tj1 - ta1,
+            process_scan_s=tps1 - ta1,
+            join_s=tj1 - tps1,
             ok="device_read" not in errors,
             errors=tuple(errors),
         )
         self._publish(host_sample, device_owner, stats, now_mono=tj1,
-                      allocatable=allocatable, allocated=allocated)
+                      allocatable=allocatable, allocated=allocated,
+                      holders=holders)
         tp1 = self._clock()
         stats.publish_s = tp1 - tj1
         stats.total_s = tp1 - t0
@@ -203,7 +219,7 @@ class Collector:
     # --------------------------------------------------------------- publish
 
     def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float,
-                 allocatable=None, allocated=None) -> None:
+                 allocatable=None, allocated=None, holders=None) -> None:
         b = SnapshotBuilder(prefix_cache=self._prefix_cache)
 
         # Declare the full schema up front so families are present (and typed)
@@ -213,8 +229,21 @@ class Collector:
         if self._legacy_metrics:
             b.declare(schema.LEGACY_POD_MEMORY_USAGE)
             b.declare(schema.LEGACY_POD_MEMORY_PERC_USAGE)
+        if self._process_scanner is not None:
+            b.declare(schema.TPU_CHIP_PROCESS_INFO)
+
+        # device_path -> holders, for the per-chip process join. Holder sets
+        # are tiny (≈ one workload process per chip), so a plain dict-of-lists
+        # rebuilt per poll is cheaper than caching machinery.
+        holders_by_path: dict[str, list] = {}
+        if holders:
+            for h in holders:
+                holders_by_path.setdefault(h.device_path, []).append(h)
 
         pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used, hbm_total]
+        # (pod, pid) -> [hbm_used, hbm_total] for the legacy aliases; pid is
+        # "" when no process scanner or no holder was seen for the chip.
+        legacy_rollup: dict[tuple[str, str], list[float]] = {}
 
         if host_sample is not None:
             dt = None
@@ -314,34 +343,50 @@ class Collector:
                     rec[2] = folded
                     rec[3] = seq
 
+                chip_holders = (
+                    holders_by_path.get(info.device_path)
+                    if holders_by_path
+                    else None
+                )
+                if chip_holders:
+                    for h in chip_holders:
+                        b.add(
+                            schema.TPU_CHIP_PROCESS_INFO,
+                            1.0,
+                            chip_tuple + (str(h.pid), h.comm, h.pod_uid),
+                        )
+
                 if owner is not None:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
                     agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0.0])
                     agg[0] += 1.0
                     agg[1] += chip.hbm_used_bytes
                     agg[2] += chip.hbm_total_bytes
+                    if self._legacy_metrics:
+                        # The legacy shape has no namespace label (the
+                        # reference collided on pod name, main.go:113); sum
+                        # across namespaces rather than last-write-wins. With
+                        # the process scanner on, the pid label carries the
+                        # chip's primary (lowest-pid) holder so each chip's
+                        # HBM is counted exactly once even under forked
+                        # workers; "" otherwise.
+                        pid = str(chip_holders[0].pid) if chip_holders else ""
+                        lagg = legacy_rollup.setdefault((owner.pod, pid), [0.0, 0.0])
+                        lagg[0] += used
+                        lagg[1] += total_b
 
             self._prev_ici_at = now_mono
 
-        legacy_rollup: dict[str, list[float]] = {}
         for rk, (nchips, hbm, hbm_total) in pod_rollup.items():
             b.add(schema.TPU_POD_CHIP_COUNT, nchips, rk)
             b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
-            if self._legacy_metrics:
-                # The legacy shape has no namespace label (the reference
-                # collided on pod name, main.go:113); sum across namespaces
-                # rather than last-write-wins.
-                agg = legacy_rollup.setdefault(rk[0], [0.0, 0.0])
-                agg[0] += hbm
-                agg[1] += hbm_total
-        for pod, (hbm, hbm_total) in legacy_rollup.items():
-            # Reference-name aliases (main.go:24,31): {pid, pod} with pid
-            # always "" — see schema.LEGACY_* docstrings.
-            b.add(schema.LEGACY_POD_MEMORY_USAGE, hbm, ("", pod))
+        for (pod, pid), (hbm, hbm_total) in legacy_rollup.items():
+            # Reference-name aliases (main.go:24,31), label shape {pid, pod}.
+            b.add(schema.LEGACY_POD_MEMORY_USAGE, hbm, (pid, pod))
             b.add(
                 schema.LEGACY_POD_MEMORY_PERC_USAGE,
                 schema.hbm_used_percent(hbm, hbm_total),
-                ("", pod),
+                (pid, pod),
             )
 
         # Kubelet inventory (absent when the source cannot report it; an
@@ -360,6 +405,7 @@ class Collector:
         for phase, dur in (
             ("device_read", stats.device_read_s),
             ("attribution", stats.attribution_s),
+            ("process_scan", stats.process_scan_s),
             ("join", stats.join_s),
             ("publish", self.last_stats.publish_s),
             ("total", self.last_stats.total_s),

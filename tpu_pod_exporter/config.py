@@ -30,6 +30,9 @@ class ExporterConfig:
     checkpoint_path: str = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0
+    process_metrics: bool = False  # procfs scan: which host pids hold which chips
+    proc_root: str = "/proc"       # injectable for tests / sidecar mounts
+    process_full_scan_every: int = 10  # polls between full /proc walks
     legacy_metrics: bool = False   # also emit the reference's gpu_* metric names
     accelerator: str = ""          # override TPU_ACCELERATOR_TYPE
     slice_name: str = ""

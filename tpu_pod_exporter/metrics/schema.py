@@ -18,7 +18,10 @@ reference's label-schema defects:
 Semantic shift, documented rather than faked: NVML reports *per-process*
 device memory (``main.go:135,147``); TPU runtimes pin whole chips to one
 container, so the honest TPU analog is per-chip metrics labeled with the
-owning pod. There is no ``pid`` label by design.
+owning pod. Core chip metrics carry no ``pid`` label by design; the
+per-process dimension lives in :data:`TPU_CHIP_PROCESS_INFO`, fed by the
+procfs scanner with *correct* host PIDs (unlike the reference's broken
+container-PID join, SURVEY.md §2.6).
 """
 
 from __future__ import annotations
@@ -88,6 +91,25 @@ TPU_ICI_TRANSFERRED_BYTES_TOTAL = MetricSpec(
     help="Cumulative bytes transferred over one inter-chip link.",
     type=COUNTER,
     label_names=ICI_LABELS,
+)
+
+# --- Per-process holders (procfs scanner; --process-metrics) -----------------
+
+# pid/comm/pod_uid come from /proc: the process that holds the chip's device
+# file open and its cgroup-derived pod UID. This is the honest TPU analog of
+# the reference's per-process NVML dimension (main.go:135-154) — correct host
+# PIDs with no exec and no PID-namespace confusion (SURVEY.md §2.6).
+PROCESS_LABELS: tuple[str, ...] = CHIP_LABELS + ("pid", "comm", "pod_uid")
+
+TPU_CHIP_PROCESS_INFO = MetricSpec(
+    name="tpu_chip_process_info",
+    help=(
+        "One series per (process, chip): the process with this host pid holds "
+        "the chip's device file open; value is always 1. pod/namespace/container "
+        "come from the kubelet allocation, pod_uid from the process's cgroup."
+    ),
+    type=GAUGE,
+    label_names=PROCESS_LABELS,
 )
 
 # --- Pod-level rollups -------------------------------------------------------
@@ -180,17 +202,19 @@ TPU_EXPORTER_INFO = MetricSpec(
 # unchanged during migration. Semantic shift, documented in the help text:
 # the reference's value was per-process GPU memory keyed {pid, pod}
 # (main.go:147-150); TPU runtimes pin whole chips to one container, so the
-# honest equivalent is per-pod totals and pid is always "".
+# honest equivalent is per-pod HBM totals. The pid label carries the chip's
+# primary holder pid when the procfs scanner is on (--process-metrics),
+# else "".
 LEGACY_POD_MEMORY_USAGE = MetricSpec(
     name="pod_gpu_memory_usage",
-    help="DEPRECATED migration alias: device memory used by this pod's chips, bytes (TPU: per-pod HBM; pid label is always empty).",
+    help="DEPRECATED migration alias: device memory used by this pod's chips, bytes (TPU: per-pod HBM; pid is the chip's holder pid when --process-metrics is on, else empty).",
     type=GAUGE,
     label_names=("pid", "pod"),
 )
 
 LEGACY_POD_MEMORY_PERC_USAGE = MetricSpec(
     name="docker_gpu_memory_perc_usage",
-    help="DEPRECATED migration alias: percent of this pod's chips' total device memory in use (pid label is always empty).",
+    help="DEPRECATED migration alias: percent of this pod's chips' total device memory in use (pid is the chip's holder pid when --process-metrics is on, else empty).",
     type=GAUGE,
     label_names=("pid", "pod"),
 )
