@@ -1,0 +1,246 @@
+"""Exposition parser + slice aggregator (SURVEY.md §2.8, baseline config 4).
+
+Rollups are fed from real per-host Collector output (encode → parse → fold),
+so the aggregator is tested against the exact bytes exporters serve.
+"""
+
+import math
+import urllib.request
+
+import pytest
+
+from tpu_pod_exporter.aggregate import SliceAggregator
+from tpu_pod_exporter.app import ExporterApp
+from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+from tpu_pod_exporter.collector import Collector
+from tpu_pod_exporter.config import ExporterConfig
+from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.metrics.parse import ParseError, parse_exposition, parse_families
+from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter.topology import HostTopology
+
+GIB = 1024**3
+
+
+class TestParser:
+    def test_bare_sample(self):
+        (s,) = parse_exposition("tpu_exporter_up 1\n")
+        assert s == ("tpu_exporter_up", {}, 1.0)
+
+    def test_labels(self):
+        (s,) = parse_exposition('m{a="x",b="y"} 2.5\n')
+        assert s.labels == {"a": "x", "b": "y"}
+        assert s.value == 2.5
+
+    def test_escapes_roundtrip(self):
+        (s,) = parse_exposition('m{a="q\\"uo\\\\te\\nnl"} 1\n')
+        assert s.labels == {"a": 'q"uo\\te\nnl'}
+
+    def test_timestamp_dropped(self):
+        (s,) = parse_exposition("m 3 1700000000000\n")
+        assert s.value == 3.0
+
+    def test_nan_and_inf(self):
+        samples = list(parse_exposition("a NaN\nb +Inf\nc -Inf\n"))
+        assert math.isnan(samples[0].value)
+        assert samples[1].value == math.inf
+        assert samples[2].value == -math.inf
+
+    def test_comments_and_blanks_skipped(self):
+        text = "# HELP m help\n# TYPE m gauge\n\nm 1\n# EOF\n"
+        assert len(list(parse_exposition(text))) == 1
+
+    @pytest.mark.parametrize(
+        "bad",
+        ['m{a=x} 1', 'm{a="x} 1', "m{=} 1", "m", 'm{a="x"} notanumber', "{} 1"],
+    )
+    def test_malformed_raises(self, bad):
+        with pytest.raises(ParseError):
+            list(parse_exposition(bad + "\n"))
+
+    def test_roundtrip_with_own_renderer(self):
+        """encode() output must parse back to identical values."""
+        backend = FakeBackend(
+            chips=2, script=FakeChipScript(hbm_total_bytes=8.0, hbm_used_bytes=2.0)
+        )
+        store = SnapshotStore()
+        Collector(backend, FakeAttribution(), store).poll_once()
+        fams = parse_families(store.current().encode().decode())
+        assert len(fams["tpu_hbm_used_bytes"]) == 2
+        for s in fams["tpu_hbm_used_bytes"]:
+            assert s.value == 2.0
+
+
+def make_host_text(worker_id: int, pod="llm-train-0", chips=4, used_gib=1.0):
+    """One v5p host's real exposition bytes."""
+    backend = FakeBackend(
+        chips=chips,
+        script=FakeChipScript(
+            hbm_total_bytes=96 * GIB,
+            hbm_used_bytes=used_gib * GIB,
+            duty_cycle_percent=60.0 + worker_id,
+            ici_link_count=6,
+            ici_bytes_per_step=1_000_000.0,
+        ),
+    )
+    attr = FakeAttribution(
+        [simple_allocation(pod, [str(i) for i in range(chips)], namespace="ml")]
+    )
+    topo = HostTopology(
+        accelerator="v5p-64", slice_name="slice-a",
+        host=f"host-{worker_id}", worker_id=str(worker_id),
+    )
+    store = SnapshotStore()
+    c = Collector(backend, attr, store, topology=topo)
+    c.poll_once()
+    c.poll_once()  # second poll so ICI rates have a dt window
+    return store.current().encode().decode()
+
+
+class StaticFetch:
+    """Injectable fetch: target -> canned text, or raise."""
+
+    def __init__(self, pages: dict[str, str], down: set[str] = frozenset()):
+        self.pages = pages
+        self.down = set(down)
+
+    def __call__(self, target: str, timeout_s: float) -> str:
+        if target in self.down:
+            raise ConnectionError(f"{target} unreachable")
+        return self.pages[target]
+
+
+class TestSliceAggregator:
+    def setup_method(self):
+        self.pages = {f"h{w}:8000": make_host_text(w) for w in range(2)}
+        self.store = SnapshotStore()
+
+    def agg(self, down=frozenset()):
+        return SliceAggregator(
+            tuple(self.pages), self.store,
+            fetch=StaticFetch(self.pages, down=down),
+        )
+
+    def test_slice_rollups(self):
+        self.agg().poll_once()
+        snap = self.store.current()
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        assert snap.value("tpu_slice_chip_count", key) == 8.0
+        assert snap.value("tpu_slice_hosts_reporting", key) == 2.0
+        assert snap.value("tpu_slice_hbm_used_bytes", key) == 8 * GIB
+        assert snap.value("tpu_slice_hbm_total_bytes", key) == 8 * 96 * GIB
+        assert snap.value("tpu_slice_hbm_used_percent", key) == pytest.approx(
+            100.0 * 8 / (8 * 96)
+        )
+        # hosts 0 and 1 run at 60/61% duty → mean 60.5 over 8 chips.
+        assert snap.value(
+            "tpu_slice_tensorcore_duty_cycle_avg_percent", key
+        ) == pytest.approx(60.5)
+        assert snap.value("tpu_slice_ici_bytes_per_second", key) >= 0.0
+
+    def test_workload_rollups(self):
+        self.agg().poll_once()
+        snap = self.store.current()
+        key = {"pod": "llm-train-0", "namespace": "ml", "slice_name": "slice-a"}
+        assert snap.value("tpu_workload_chip_count", key) == 8.0
+        assert snap.value("tpu_workload_hosts", key) == 2.0
+        assert snap.value("tpu_workload_hbm_used_bytes", key) == 8 * GIB
+
+    def test_down_target_drops_out_and_recovers(self):
+        fetch = StaticFetch(self.pages, down={"h1:8000"})
+        a = SliceAggregator(tuple(self.pages), self.store, fetch=fetch)
+        a.poll_once()
+        snap = self.store.current()
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 0.0
+        assert snap.value("tpu_aggregator_target_up", {"target": "h0:8000"}) == 1.0
+        assert snap.value("tpu_slice_chip_count", key) == 4.0
+        assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
+        assert snap.value(
+            "tpu_aggregator_scrape_errors_total", {"target": "h1:8000"}
+        ) == 1.0
+        fetch.down.clear()
+        a.poll_once()
+        snap = self.store.current()
+        assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 1.0
+        assert snap.value("tpu_slice_chip_count", key) == 8.0
+        # Error counter is cumulative, not reset by recovery.
+        assert snap.value(
+            "tpu_aggregator_scrape_errors_total", {"target": "h1:8000"}
+        ) == 1.0
+
+    def test_garbage_body_counts_as_down_without_partial_sums(self):
+        self.pages["h1:8000"] = (
+            self.pages["h1:8000"] + 'broken{oops} not-a-number\n'
+        )
+        self.agg().poll_once()
+        snap = self.store.current()
+        assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 0.0
+        # h1 contributed nothing despite its valid prefix.
+        assert snap.value(
+            "tpu_slice_chip_count",
+            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+        ) == 4.0
+
+    def test_unallocated_chips_do_not_create_workloads(self):
+        store = SnapshotStore()
+        Collector(FakeBackend(chips=2), FakeAttribution(), store).poll_once()
+        text = store.current().encode().decode()
+        agg_store = SnapshotStore()
+        SliceAggregator(
+            ("h:1",), agg_store, fetch=StaticFetch({"h:1": text})
+        ).poll_once()
+        snap = agg_store.current()
+        assert parse_families(snap.encode().decode()).get("tpu_workload_chip_count") in (None, [])
+        # Chip-level slice rollups still exist (empty slice/accelerator labels).
+        assert snap.value(
+            "tpu_slice_chip_count", {"slice_name": "", "accelerator": ""}
+        ) == 2.0
+
+    def test_empty_targets_rejected(self):
+        with pytest.raises(ValueError):
+            SliceAggregator((), SnapshotStore())
+
+
+class TestAggregatorOverHTTP:
+    def test_end_to_end(self):
+        """Real exporter → real scrape → aggregator's own /metrics."""
+        backend = FakeBackend(
+            chips=4,
+            script=FakeChipScript(hbm_total_bytes=96 * GIB, hbm_used_bytes=GIB),
+        )
+        attr = FakeAttribution(
+            [simple_allocation("job-0", ["0", "1", "2", "3"], namespace="ml")]
+        )
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", interval_s=0.05,
+            accelerator="v5e-16", slice_name="s-e2e", node_name="n0", worker_id="0",
+        )
+        app = ExporterApp(cfg, backend=backend, attribution=attr)
+        app.start()
+        agg_store = SnapshotStore()
+        server = None
+        try:
+            agg = SliceAggregator(
+                (f"127.0.0.1:{app.port}",), agg_store, timeout_s=5.0
+            )
+            agg.poll_once()
+            server = MetricsServer(agg_store, host="127.0.0.1", port=0)
+            server.start()
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5
+            ) as resp:
+                body = resp.read().decode()
+            fams = parse_families(body)
+            (chip_count,) = fams["tpu_slice_chip_count"]
+            assert chip_count.labels == {
+                "slice_name": "s-e2e", "accelerator": "v5e-16"
+            }
+            assert chip_count.value == 4.0
+            (up,) = fams["tpu_aggregator_target_up"]
+            assert up.value == 1.0
+        finally:
+            if server is not None:
+                server.stop()
+            app.stop()

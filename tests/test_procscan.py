@@ -83,6 +83,14 @@ class TestFullScan:
         holders = ProcScanner(proc_root=str(tmp_path)).scan()
         assert [h.device_path for h in holders] == ["/dev/accel2"]
 
+    def test_deleted_device_node_still_joins(self, tmp_path):
+        # Runtime restart recreated /dev/accel0 while pid 70 holds the old
+        # inode: readlink reports "… (deleted)". The wedged holder must still
+        # attribute to the chip's canonical path.
+        add_proc(tmp_path, 70, ["/dev/accel0 (deleted)"])
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert [h.device_path for h in holders] == ["/dev/accel0"]
+
     def test_vfio_paths_match(self, tmp_path):
         add_proc(tmp_path, 60, ["/dev/vfio/17"], cgroup=CGROUP_NON_POD)
         holders = ProcScanner(proc_root=str(tmp_path)).scan()
@@ -229,6 +237,31 @@ class TestCollectorIntegration:
         assert snap.value(
             "pod_gpu_memory_usage", {"pid": "", "pod": "train-0"}
         ) == 50.0
+
+    def test_transient_scan_failure_keeps_last_holders(self, tmp_path):
+        # One failed scan must not blink tpu_chip_process_info out (nor flip
+        # the legacy pid label): the last good holder set is reused within
+        # the bounded-staleness window.
+        add_proc(tmp_path, 4242, ["/dev/accel0"])
+        real = ProcScanner(proc_root=str(tmp_path))
+
+        class Flaky:
+            fail = False
+
+            def scan(self):
+                if self.fail:
+                    raise RuntimeError("transient")
+                return real.scan()
+
+        flaky = Flaky()
+        store = SnapshotStore()
+        c = make_collector(store, flaky)
+        c.poll_once()
+        flaky.fail = True
+        stats = c.poll_once()
+        assert "process_scan" in stats.errors
+        snap = store.current()
+        assert snap.value("tpu_chip_process_info", process_labels(0, 4242)) == 1.0
 
     def test_scanner_failure_is_contained(self):
         class BoomScanner:

@@ -1,0 +1,275 @@
+"""Slice-level aggregator — cross-host rollups without a Prometheus.
+
+On a multi-host slice (e.g. v5p-64: 8 hosts × 4 chips) each host runs one
+exporter and cross-host aggregation is a *label join*, normally done by
+Prometheus recording rules (SURVEY.md §2.8: exporters never talk to each
+other; ICI/DCN are measured quantities, not transports). This optional
+component computes the same joins for setups without a Prometheus: it
+scrapes every per-host ``/metrics``, sums per-slice and per-workload, and
+re-exports the rollups on its own ``/metrics``.
+
+Deliberately an *observer of exporters*, not a peer: it consumes the public
+exposition format over HTTP — the same bytes Prometheus would — so it works
+against any mix of exporter versions and needs no new protocol. A target
+that fails to scrape is reported down (``tpu_aggregator_target_up 0``) and
+its chips simply drop out of the sums for that round; partial slices stay
+honest via ``tpu_slice_hosts_reporting``.
+
+Run: ``python -m tpu_pod_exporter.aggregate --targets h0:8000,h1:8000``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import signal
+import threading
+import time
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+from tpu_pod_exporter.collector import CollectorLoop
+from tpu_pod_exporter.metrics import CounterStore, SnapshotBuilder, SnapshotStore
+from tpu_pod_exporter.metrics import schema
+from tpu_pod_exporter.metrics.parse import ParseError, parse_exposition
+from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter.utils import RateLimitedLogger
+
+log = logging.getLogger("tpu_pod_exporter.aggregate")
+
+
+def default_fetch(target: str, timeout_s: float) -> str:
+    """``host:port`` (or full URL) → exposition text."""
+    url = target if target.startswith(("http://", "https://")) else f"http://{target}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied targets
+        return resp.read().decode("utf-8", errors="replace")
+
+
+class _SliceAgg:
+    """Mutable per-(slice, accelerator) accumulator for one round."""
+
+    __slots__ = ("hosts", "chips", "hbm_used", "hbm_total", "duty_sum",
+                 "duty_n", "ici_bw")
+
+    def __init__(self) -> None:
+        self.hosts: set[str] = set()
+        self.chips = 0
+        self.hbm_used = 0.0
+        self.hbm_total = 0.0
+        self.duty_sum = 0.0
+        self.duty_n = 0
+        self.ici_bw = 0.0
+
+
+class _WorkloadAgg:
+    __slots__ = ("chips", "hbm_used", "hosts")
+
+    def __init__(self) -> None:
+        self.chips = 0.0
+        self.hbm_used = 0.0
+        self.hosts: set[str] = set()
+
+
+class SliceAggregator:
+    """Scrape N per-host exporters, publish slice/workload rollups.
+
+    Exposes ``poll_once`` so :class:`~tpu_pod_exporter.collector.CollectorLoop`
+    can drive it on the same drift-free schedule as the exporter's own loop.
+    ``fetch`` is injectable for tests (no sockets needed).
+    """
+
+    def __init__(
+        self,
+        targets: tuple[str, ...],
+        store: SnapshotStore,
+        timeout_s: float = 2.0,
+        fetch=default_fetch,
+        wallclock=time.time,
+    ) -> None:
+        if not targets:
+            raise ValueError("aggregator needs at least one target")
+        self._targets = targets
+        self._store = store
+        self._timeout_s = timeout_s
+        self._fetch = fetch
+        self._wallclock = wallclock
+        self._counters = CounterStore()
+        self._rlog = RateLimitedLogger(log)
+        self._pool = ThreadPoolExecutor(
+            max_workers=min(len(targets), 16),
+            thread_name_prefix="tpu-agg-scrape",
+        )
+
+    # ------------------------------------------------------------------ round
+
+    def poll_once(self) -> None:
+        results = list(
+            self._pool.map(self._scrape_one, self._targets)
+        )  # [(target, text|None, duration_s)]
+        self._publish(results)
+
+    def _scrape_one(self, target: str) -> tuple[str, str | None, float]:
+        t0 = time.monotonic()
+        try:
+            text = self._fetch(target, self._timeout_s)
+        except Exception as e:  # noqa: BLE001 — a down host is data, not death
+            self._rlog.warning(f"scrape:{target}", "scrape of %s failed: %s", target, e)
+            return target, None, time.monotonic() - t0
+        return target, text, time.monotonic() - t0
+
+    # ---------------------------------------------------------------- publish
+
+    def _publish(self, results) -> None:
+        b = SnapshotBuilder()
+        for spec in schema.AGGREGATE_SPECS:
+            b.declare(spec)
+
+        slices: dict[tuple[str, str], _SliceAgg] = {}
+        workloads: dict[tuple[str, str, str], _WorkloadAgg] = {}
+
+        for target, text, duration_s in results:
+            ok = text is not None
+            if ok:
+                # Parse fully before folding: a mid-body ParseError must not
+                # leave a half-consumed host in the sums while the target is
+                # reported down.
+                try:
+                    samples = list(parse_exposition(text))
+                except ParseError as e:
+                    ok = False
+                    self._rlog.warning(
+                        f"parse:{target}", "bad exposition from %s: %s", target, e
+                    )
+                else:
+                    self._consume(samples, slices, workloads)
+            if not ok:
+                self._counters.inc(
+                    schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name, (target,)
+                )
+            b.add(schema.TPU_AGG_TARGET_UP, 1.0 if ok else 0.0, (target,))
+            b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
+
+        for key, agg in slices.items():
+            b.add(schema.TPU_SLICE_HOSTS_REPORTING, float(len(agg.hosts)), key)
+            b.add(schema.TPU_SLICE_CHIP_COUNT, float(agg.chips), key)
+            b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
+            b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
+            b.add(
+                schema.TPU_SLICE_HBM_USED_PERCENT,
+                schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
+                key,
+            )
+            if agg.duty_n:
+                b.add(
+                    schema.TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
+                    agg.duty_sum / agg.duty_n,
+                    key,
+                )
+            b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
+
+        for key, w in workloads.items():
+            b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
+            b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
+            b.add(schema.TPU_WORKLOAD_HOSTS, float(len(w.hosts)), key)
+
+        for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
+            b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
+        b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
+        self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
+
+    @staticmethod
+    def _consume(samples, slices, workloads) -> None:
+        """Fold one host's parsed samples into the round accumulators."""
+        for s in samples:
+            name = s.name
+            if name == "tpu_hbm_used_bytes":
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.chips += 1
+                agg.hbm_used += s.value
+                agg.hosts.add(s.labels.get("host", ""))
+            elif name == "tpu_hbm_total_bytes":
+                SliceAggregator._slice(slices, s.labels).hbm_total += s.value
+            elif name == "tpu_tensorcore_duty_cycle_percent":
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.duty_sum += s.value
+                agg.duty_n += 1
+            elif name == "tpu_ici_link_bandwidth_bytes_per_second":
+                SliceAggregator._slice(slices, s.labels).ici_bw += s.value
+            elif name in ("tpu_pod_chip_count", "tpu_pod_hbm_used_bytes"):
+                pod = s.labels.get("pod", "")
+                if not pod:
+                    continue
+                key = (pod, s.labels.get("namespace", ""), s.labels.get("slice_name", ""))
+                w = workloads.get(key)
+                if w is None:
+                    w = workloads[key] = _WorkloadAgg()
+                if name == "tpu_pod_chip_count":
+                    w.chips += s.value
+                    w.hosts.add(s.labels.get("host", ""))
+                else:
+                    w.hbm_used += s.value
+
+    @staticmethod
+    def _slice(slices: dict, labels: dict[str, str]) -> _SliceAgg:
+        key = (labels.get("slice_name", ""), labels.get("accelerator", ""))
+        agg = slices.get(key)
+        if agg is None:
+            agg = slices[key] = _SliceAgg()
+        return agg
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-pod-exporter-aggregate",
+        description="Scrape per-host TPU exporters; serve slice-level rollups.",
+    )
+    p.add_argument("--targets", required=True,
+                   help="comma-separated host:port (or URL) exporter targets")
+    p.add_argument("--port", type=int, default=9100)
+    p.add_argument("--host", default="0.0.0.0")
+    p.add_argument("--interval-s", type=float, default=5.0)
+    p.add_argument("--timeout-s", type=float, default=2.0)
+    p.add_argument("--log-level", default="info")
+    ns = p.parse_args(argv)
+    logging.basicConfig(
+        level=getattr(logging, ns.log_level.upper(), logging.INFO),
+        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+    )
+
+    targets = tuple(t.strip() for t in ns.targets.split(",") if t.strip())
+    store = SnapshotStore()
+    agg = SliceAggregator(targets, store, timeout_s=ns.timeout_s)
+    loop = CollectorLoop(agg, interval_s=ns.interval_s)
+    server = MetricsServer(
+        store, host=ns.host, port=ns.port,
+        health_max_age_s=max(10.0 * ns.interval_s, 10.0),
+    )
+
+    stop = threading.Event()
+
+    def _on_signal(signum, frame) -> None:  # noqa: ARG001
+        log.info("signal %d: draining", signum)
+        stop.set()
+
+    signal.signal(signal.SIGTERM, _on_signal)
+    signal.signal(signal.SIGINT, _on_signal)
+
+    agg.poll_once()  # synchronous first round so /readyz flips immediately
+    loop.start()
+    server.start()
+    log.info("aggregating %d targets on :%d every %.1fs",
+             len(targets), server.port, ns.interval_s)
+    stop.wait()
+    loop.stop()
+    server.stop()
+    agg.close()
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

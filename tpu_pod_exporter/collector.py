@@ -100,6 +100,11 @@ class Collector:
         )
         self._last_attr: AttributionSnapshot | None = None
         self._last_attr_at: float = 0.0
+        # Last good holder set, reused under the same bounded-staleness rule
+        # as attribution: a transient scan failure must not flip the legacy
+        # series identity from {pid="<holder>"} to {pid=""} for one poll.
+        self._last_holders: tuple | None = None
+        self._last_holders_at: float = 0.0
         # (chip_id, owner pod/ns/container) -> (chip label tuple,
         # {link id -> link label tuple}). Label tuples are invariant between
         # churn events, so rebuilding + re-interning them per chip per poll
@@ -158,9 +163,17 @@ class Collector:
         if self._process_scanner is not None:
             try:
                 holders = self._process_scanner.scan()
+                self._last_holders = holders
+                self._last_holders_at = self._clock()
             except Exception as e:  # noqa: BLE001 — never die in the loop
                 errors.append("process_scan")
                 self._rlog.warning("process_scan", "process scan failed: %s", e)
+                if (
+                    self._last_holders is not None
+                    and self._clock() - self._last_holders_at
+                    <= self._attribution_max_stale_s
+                ):
+                    holders = self._last_holders
         tps1 = self._clock()
 
         # Phase 3: join (replaces main.go:141-154).

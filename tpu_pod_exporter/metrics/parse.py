@@ -1,0 +1,107 @@
+"""Prometheus text-exposition parser (format 0.0.4).
+
+The inverse of the renderer in :mod:`tpu_pod_exporter.metrics.registry`,
+used by the slice aggregator to consume per-host exporters' ``/metrics``
+bodies. Kept dependency-free and strict about the things that matter for
+aggregation correctness (label-value escape sequences, NaN/Inf, optional
+timestamps) while tolerating unknown families — an aggregator must not
+break when a newer exporter adds metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, NamedTuple
+
+
+class ParsedSample(NamedTuple):
+    name: str
+    labels: dict[str, str]
+    value: float
+
+
+class ParseError(ValueError):
+    """A metric line was structurally malformed."""
+
+
+def _parse_label_block(block: str, line: str) -> dict[str, str]:
+    """``name="value",…`` (no surrounding braces) → dict, honoring the
+    exposition escapes inside values: ``\\\\``, ``\\"``, ``\\n``."""
+    labels: dict[str, str] = {}
+    i, n = 0, len(block)
+    while i < n:
+        eq = block.find("=", i)
+        if eq < 0:
+            raise ParseError(f"label without '=': {line!r}")
+        name = block[i:eq].strip()
+        if not name:
+            raise ParseError(f"empty label name: {line!r}")
+        if eq + 1 >= n or block[eq + 1] != '"':
+            raise ParseError(f"unquoted label value: {line!r}")
+        j = eq + 2
+        out: list[str] = []
+        while True:
+            if j >= n:
+                raise ParseError(f"unterminated label value: {line!r}")
+            ch = block[j]
+            if ch == "\\":
+                if j + 1 >= n:
+                    raise ParseError(f"dangling escape: {line!r}")
+                nxt = block[j + 1]
+                out.append({"n": "\n", '"': '"', "\\": "\\"}.get(nxt, "\\" + nxt))
+                j += 2
+            elif ch == '"':
+                break
+            else:
+                out.append(ch)
+                j += 1
+        labels[name] = "".join(out)
+        j += 1  # past closing quote
+        while j < n and block[j] in ", ":
+            j += 1
+        i = j
+    return labels
+
+
+def parse_exposition(text: str) -> Iterator[ParsedSample]:
+    """Yield every sample in an exposition body. ``# HELP``/``# TYPE``/other
+    comments are skipped; trailing timestamps are accepted and dropped."""
+    for raw in text.splitlines():
+        line = raw.strip()
+        if not line or line.startswith("#"):
+            continue
+        if line[-1] == "{":
+            raise ParseError(f"truncated line: {line!r}")
+        brace = line.find("{")
+        if brace >= 0:
+            close = line.rfind("}")
+            if close < brace:
+                raise ParseError(f"unbalanced braces: {line!r}")
+            name = line[:brace].strip()
+            labels = _parse_label_block(line[brace + 1 : close], line)
+            rest = line[close + 1 :].strip()
+        else:
+            parts = line.split(None, 1)
+            if len(parts) < 2:
+                raise ParseError(f"missing value: {line!r}")
+            name, rest = parts[0], parts[1]
+            labels = {}
+        if not name:
+            raise ParseError(f"missing metric name: {line!r}")
+        value_str = rest.split()[0] if rest else ""
+        if not value_str:
+            raise ParseError(f"missing value: {line!r}")
+        try:
+            value = float(value_str)
+        except ValueError as e:
+            raise ParseError(f"bad value {value_str!r}: {line!r}") from e
+        yield ParsedSample(name, labels, value)
+
+
+def parse_families(text: str) -> dict[str, list[ParsedSample]]:
+    """Samples grouped by family name (counter samples keep their ``_total``
+    suffix — this is the text format's sample name, not the OpenMetrics
+    family abstraction)."""
+    out: dict[str, list[ParsedSample]] = {}
+    for s in parse_exposition(text):
+        out.setdefault(s.name, []).append(s)
+    return out

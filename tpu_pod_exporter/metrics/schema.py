@@ -240,6 +240,134 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
 )
 
 
+# --- Slice-aggregator schema (tpu_pod_exporter.aggregate) --------------------
+# Served by the optional aggregator, NOT by per-host exporters (hence not in
+# ALL_SPECS). Cross-host rollups normally live in Prometheus recording rules
+# (SURVEY.md §2.8); the aggregator computes the same label joins for setups
+# without one, scraping each host's /metrics and re-exporting slice sums.
+
+SLICE_LABELS: tuple[str, ...] = ("slice_name", "accelerator")
+
+TPU_SLICE_HOSTS_REPORTING = MetricSpec(
+    name="tpu_slice_hosts_reporting",
+    help="Hosts of this slice contributing chip samples this round (a scraped-but-chipless host counts in tpu_aggregator_target_up, not here).",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_CHIP_COUNT = MetricSpec(
+    name="tpu_slice_chip_count",
+    help="TPU chips reporting across all scraped hosts of this slice.",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_HBM_USED_BYTES = MetricSpec(
+    name="tpu_slice_hbm_used_bytes",
+    help="Sum of HBM bytes in use across all chips of this slice.",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_HBM_TOTAL_BYTES = MetricSpec(
+    name="tpu_slice_hbm_total_bytes",
+    help="Sum of HBM capacity across all chips of this slice.",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_HBM_USED_PERCENT = MetricSpec(
+    name="tpu_slice_hbm_used_percent",
+    help="Percent of the slice's total HBM capacity in use (0-100).",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_DUTY_CYCLE_AVG_PERCENT = MetricSpec(
+    name="tpu_slice_tensorcore_duty_cycle_avg_percent",
+    help="Mean TensorCore duty cycle across the slice's reporting chips (0-100).",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+TPU_SLICE_ICI_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_slice_ici_bytes_per_second",
+    help="Sum of per-link ICI traffic rates across the slice.",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+# Cross-host workload rollups: a multi-host JobSet replica appears as the
+# same {pod, namespace} on several hosts; these sum over that.
+WORKLOAD_LABELS: tuple[str, ...] = ("pod", "namespace", "slice_name")
+
+TPU_WORKLOAD_CHIP_COUNT = MetricSpec(
+    name="tpu_workload_chip_count",
+    help="TPU chips allocated to this workload across all hosts of the slice.",
+    type=GAUGE,
+    label_names=WORKLOAD_LABELS,
+)
+
+TPU_WORKLOAD_HBM_USED_BYTES = MetricSpec(
+    name="tpu_workload_hbm_used_bytes",
+    help="HBM bytes in use across all chips allocated to this workload, slice-wide.",
+    type=GAUGE,
+    label_names=WORKLOAD_LABELS,
+)
+
+TPU_WORKLOAD_HOSTS = MetricSpec(
+    name="tpu_workload_hosts",
+    help="Hosts on which this workload currently holds TPU chips.",
+    type=GAUGE,
+    label_names=WORKLOAD_LABELS,
+)
+
+# Aggregator self-metrics.
+TPU_AGG_TARGET_UP = MetricSpec(
+    name="tpu_aggregator_target_up",
+    help="1 if this per-host exporter target was scraped successfully in the last round.",
+    type=GAUGE,
+    label_names=("target",),
+)
+
+TPU_AGG_SCRAPE_DURATION_SECONDS = MetricSpec(
+    name="tpu_aggregator_scrape_duration_seconds",
+    help="Duration of the last scrape of this target.",
+    type=GAUGE,
+    label_names=("target",),
+)
+
+TPU_AGG_SCRAPE_ERRORS_TOTAL = MetricSpec(
+    name="tpu_aggregator_scrape_errors_total",
+    help="Count of failed scrapes since aggregator start, by target.",
+    type=COUNTER,
+    label_names=("target",),
+)
+
+TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS = MetricSpec(
+    name="tpu_aggregator_last_round_timestamp_seconds",
+    help="Unix timestamp of the most recent completed aggregation round.",
+    type=GAUGE,
+)
+
+AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
+    TPU_SLICE_HOSTS_REPORTING,
+    TPU_SLICE_CHIP_COUNT,
+    TPU_SLICE_HBM_USED_BYTES,
+    TPU_SLICE_HBM_TOTAL_BYTES,
+    TPU_SLICE_HBM_USED_PERCENT,
+    TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
+    TPU_SLICE_ICI_BYTES_PER_SECOND,
+    TPU_WORKLOAD_CHIP_COUNT,
+    TPU_WORKLOAD_HBM_USED_BYTES,
+    TPU_WORKLOAD_HOSTS,
+    TPU_AGG_TARGET_UP,
+    TPU_AGG_SCRAPE_DURATION_SECONDS,
+    TPU_AGG_SCRAPE_ERRORS_TOTAL,
+    TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS,
+)
+
+
 def hbm_used_percent(used_bytes: float, total_bytes: float) -> float:
     """Bytes → percent-of-device-total (analog of ``main.go:149-150``).
 

@@ -168,6 +168,13 @@ class ProcScanner:
                     target = os.readlink(os.path.join(fd_dir, fd))
                 except OSError:
                     continue  # fd closed between listdir and readlink
+                # A runtime restart can recreate /dev/accel* while a wedged
+                # process still holds the old inode; readlink then reports
+                # "/dev/accel0 (deleted)". Strip the suffix so the holder
+                # still joins to the chip — that wedged holder is exactly
+                # what this metric exists to expose.
+                if target.endswith(" (deleted)"):
+                    target = target[: -len(" (deleted)")]
                 if target.startswith(self._prefixes) and target not in device_paths:
                     device_paths.append(target)
         except OSError:
