@@ -104,9 +104,32 @@ class TestFullScan:
         holders = ProcScanner(proc_root=str(tmp_path)).scan()
         assert [h.pid for h in holders] == [301]
 
-    def test_missing_proc_root_is_empty(self, tmp_path):
+    def test_missing_proc_root_raises(self, tmp_path):
+        # A whole-scan failure must surface (collector error budget +
+        # staleness fallback), not masquerade as an empty holder set.
+        from tpu_pod_exporter.procscan import ProcScanError
+
         s = ProcScanner(proc_root=str(tmp_path / "nope"))
-        assert s.scan() == ()
+        with pytest.raises(ProcScanError):
+            s.scan()
+
+    def test_proc_root_failure_preserves_cache_state(self, tmp_path):
+        import shutil
+
+        from tpu_pod_exporter.procscan import ProcScanError
+
+        add_proc(tmp_path, 100, ["/dev/accel0"])
+        s = ProcScanner(proc_root=str(tmp_path), full_scan_every=2)
+        assert len(s.scan()) == 1
+        moved = str(tmp_path) + ".moved"
+        shutil.move(str(tmp_path), moved)
+        # Verify window exhausts (cached pid unreadable → escalate to full
+        # scan → ProcScanError), state untouched.
+        with pytest.raises(ProcScanError):
+            for _ in range(4):
+                s.scan()
+        shutil.move(moved, str(tmp_path))
+        assert [h.pid for h in s.scan()] == [100]
 
     def test_sorted_by_pid(self, tmp_path):
         add_proc(tmp_path, 900, ["/dev/accel1"])

@@ -134,3 +134,78 @@ class TestPortConflict:
                 MetricsServer(store, host="127.0.0.1", port=first.port)
         finally:
             first.stop()
+
+
+class TestOpenMetrics:
+    OM_ACCEPT = {
+        "Accept": "application/openmetrics-text;version=1.0.0;q=0.9,text/plain;q=0.5"
+    }
+
+    def _counter_snapshot(self, store):
+        from tpu_pod_exporter.metrics.registry import COUNTER
+
+        b = SnapshotBuilder()
+        b.add(MetricSpec(name="g", help="a gauge"), 1.0)
+        b.add(
+            MetricSpec(name="c_total", help="a counter", type=COUNTER,
+                       label_names=("x",)),
+            3.0,
+            ("v",),
+        )
+        store.swap(b.build())
+
+    def test_negotiated_content_type_and_eof(self, served_store):
+        store, base = served_store
+        self._counter_snapshot(store)
+        status, headers, body = get(base + "/metrics", headers=self.OM_ACCEPT)
+        assert status == 200
+        assert headers["Content-Type"].startswith("application/openmetrics-text")
+        assert body.endswith(b"# EOF\n")
+        # Counter family headers drop the _total suffix; samples keep it.
+        assert b"# TYPE c counter" in body
+        assert b'c_total{x="v"} 3' in body
+
+    def test_plain_scrape_unchanged(self, served_store):
+        store, base = served_store
+        self._counter_snapshot(store)
+        status, headers, body = get(base + "/metrics")
+        assert headers["Content-Type"].startswith("text/plain")
+        assert b"# EOF" not in body
+        assert b"# TYPE c_total counter" in body
+
+    def test_openmetrics_gzip(self, served_store):
+        store, base = served_store
+        self._counter_snapshot(store)
+        status, headers, body = get(
+            base + "/metrics",
+            headers={**self.OM_ACCEPT, "Accept-Encoding": "gzip"},
+        )
+        assert headers.get("Content-Encoding") == "gzip"
+        assert gzip.decompress(body).endswith(b"# EOF\n")
+
+    def test_strict_openmetrics_parser_accepts_full_exporter_surface(self):
+        """The reference OpenMetrics parser (prometheus_client) must parse a
+        real collector snapshot — counters, info-style gauges, and all."""
+        from prometheus_client.openmetrics.parser import text_string_to_metric_families
+
+        from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation
+        from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+        from tpu_pod_exporter.collector import Collector
+
+        store = SnapshotStore()
+        backend = FakeBackend(
+            chips=2,
+            script=FakeChipScript(
+                hbm_total_bytes=8.0, hbm_used_bytes=2.0, ici_bytes_per_step=10.0
+            ),
+        )
+        attr = FakeAttribution([simple_allocation("p", ["0"], namespace="n")])
+        c = Collector(backend, attr, store, legacy_metrics=True)
+        c.poll_once()
+        c.poll_once()
+        text = store.current().encode_openmetrics().decode()
+        fams = {f.name: f for f in text_string_to_metric_families(text)}
+        assert "tpu_ici_transferred_bytes" in fams  # counter, suffix-stripped
+        assert "tpu_hbm_used_bytes" in fams
+        samples = fams["tpu_ici_transferred_bytes"].samples
+        assert all(s.name == "tpu_ici_transferred_bytes_total" for s in samples)

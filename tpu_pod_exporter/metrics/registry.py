@@ -313,6 +313,8 @@ class Snapshot:
         self._text: bytes | None = None
         self._gzipped: bytes | None = None
         self._gzip_lock = threading.Lock()
+        self._openmetrics: bytes | None = None
+        self._openmetrics_gzipped: bytes | None = None
 
     @property
     def series_count(self) -> int:
@@ -397,6 +399,44 @@ class Snapshot:
             chunks.append(rendered)
         self._text = b"".join(chunks)
         return self._text
+
+    def encode_openmetrics(self) -> bytes:
+        """OpenMetrics 1.0 exposition, derived lazily from the cached 0.0.4
+        body. The sample lines are byte-identical between the two formats for
+        gauge/counter families; only two things differ: counter HELP/TYPE
+        header lines name the family *without* its ``_total`` suffix, and the
+        body ends with ``# EOF``. So this is a handful of header rewrites on
+        the cached bytes, not a second render."""
+        if self._openmetrics is not None:
+            return self._openmetrics
+        with self._gzip_lock:
+            if self._openmetrics is None:
+                om = self.encode()
+                for fam in self._families.values():
+                    spec = fam.spec
+                    if spec.type == COUNTER and spec.name.endswith("_total"):
+                        base = spec.name[: -len("_total")]
+                        om = om.replace(
+                            f"# HELP {spec.name} ".encode(),
+                            f"# HELP {base} ".encode(),
+                            1,
+                        ).replace(
+                            f"# TYPE {spec.name} counter".encode(),
+                            f"# TYPE {base} counter".encode(),
+                            1,
+                        )
+                self._openmetrics = om + b"# EOF\n"
+        return self._openmetrics
+
+    def encode_openmetrics_gzip(self) -> bytes:
+        if self._openmetrics_gzipped is None:
+            import gzip
+
+            body = self.encode_openmetrics()
+            with self._gzip_lock:
+                if self._openmetrics_gzipped is None:
+                    self._openmetrics_gzipped = gzip.compress(body, compresslevel=1)
+        return self._openmetrics_gzipped
 
     def encode_gzip(self) -> bytes:
         """Gzipped exposition, compressed lazily on the first gzip-accepting

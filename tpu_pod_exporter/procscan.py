@@ -44,6 +44,13 @@ _CONTAINER_ID_RE = re.compile(
 DEFAULT_DEVICE_PREFIXES = ("/dev/accel", "/dev/vfio/")
 
 
+class ProcScanError(RuntimeError):
+    """The proc root itself was unreadable — the *whole scan* failed (vs. a
+    single process racing away, which is normal and silently skipped). Raised
+    so the collector's error budget + bounded-staleness holder fallback
+    engage instead of publishing a falsely-empty holder set."""
+
+
 @dataclass(frozen=True)
 class DeviceHolder:
     """One (process, device-file) pair: ``pid`` holds ``device_path`` open.
@@ -135,17 +142,17 @@ class ProcScanner:
         return self._full_scan()
 
     def _full_scan(self) -> tuple[DeviceHolder, ...]:
+        try:
+            entries = os.listdir(self._proc_root)
+        except OSError as e:
+            # Scanner state is left untouched: the failure must not wipe the
+            # cache or reset the verify window, or recovery would trust a
+            # bogus empty set for another full_scan_every polls.
+            raise ProcScanError(f"proc root {self._proc_root!r} unreadable: {e}") from e
         self.full_scans += 1
         self._scans_since_full = 0
         self._has_scanned = True
         found: dict[int, tuple[DeviceHolder, ...]] = {}
-        try:
-            entries = os.listdir(self._proc_root)
-        except OSError as e:
-            # No procfs at all (non-Linux dev box): empty, logged once-ish.
-            log.debug("proc root unreadable: %s", e)
-            self._cached = {}
-            return ()
         for entry in entries:
             if not entry.isdigit():
                 continue

@@ -29,6 +29,7 @@ from tpu_pod_exporter.metrics import SnapshotStore
 log = logging.getLogger("tpu_pod_exporter.server")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -87,12 +88,22 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _serve_metrics(self) -> None:
         snap = self.store.current()
-        headers = [("Content-Type", CONTENT_TYPE)]
+        # Content negotiation: Prometheus ≥2.5 advertises OpenMetrics in
+        # Accept; both formats are served from lazily-cached bytes, so the
+        # negotiation costs a header check, not a render.
+        openmetrics = "application/openmetrics-text" in (
+            self.headers.get("Accept") or ""
+        )
+        headers = [
+            ("Content-Type", OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE)
+        ]
         if "gzip" in (self.headers.get("Accept-Encoding") or ""):
-            body = snap.encode_gzip()  # compressed once per snapshot, cached
+            body = (
+                snap.encode_openmetrics_gzip() if openmetrics else snap.encode_gzip()
+            )  # compressed once per snapshot, cached
             headers.append(("Content-Encoding", "gzip"))
         else:
-            body = snap.encode()
+            body = snap.encode_openmetrics() if openmetrics else snap.encode()
         self.send_response(200)
         for k, v in headers:
             self.send_header(k, v)
