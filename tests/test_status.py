@@ -51,3 +51,34 @@ class TestStatusCli:
         assert status.fmt_bytes(0) == "0B"
         assert status.fmt_bytes(1024) == "1.0KiB"
         assert status.fmt_bytes(32 * 1024**3) == "32.0GiB"
+
+    def test_holder_column_with_process_metrics(self, run_status, tmp_path):
+        import os
+
+        d = tmp_path / "77" / "fd"
+        d.mkdir(parents=True)
+        os.symlink("/dev/accel1", d / "3")
+        (tmp_path / "77" / "comm").write_text("jax_worker\n")
+        (tmp_path / "77" / "cgroup").write_text("0::/user.slice\n")
+        rc, out, _ = run_status([
+            "--backend", "fake", "--fake-chips", "2", "--attribution", "none",
+            "--process-metrics", "--proc-root", str(tmp_path),
+        ])
+        assert rc == 0
+        assert "holder" in out
+        assert "77/jax_worker" in out
+
+    def test_watch_flag_parses_and_passes_rest(self, run_status, monkeypatch):
+        # One render then interrupt out of the sleep.
+        import time as time_mod
+
+        def boom(_):
+            raise KeyboardInterrupt
+
+        monkeypatch.setattr(time_mod, "sleep", boom)
+        rc, out, _ = run_status([
+            "--watch", "5", "--backend", "fake", "--fake-chips", "1",
+            "--attribution", "none",
+        ])
+        assert rc == 0
+        assert "/dev/accel0" in out

@@ -2,12 +2,16 @@
 
 ``python -m tpu_pod_exporter.status`` samples the same backends the
 exporter daemon uses (same flags/env) and prints a chip table plus per-pod
-rollups. No server, no loop; exits non-zero if the device read fails.
+rollups. Exits non-zero if the device read fails. ``--process-metrics``
+adds a holder column (host pid/comm per chip, from the procfs scanner);
+``--watch N`` re-renders every N seconds until interrupted.
 """
 
 from __future__ import annotations
 
+import argparse
 import sys
+import time
 
 from tpu_pod_exporter.app import build_attribution, build_backend
 from tpu_pod_exporter.attribution import AttributionError
@@ -33,21 +37,44 @@ def render_table(rows: list[list[str]], header: list[str]) -> str:
 
 
 def main(argv=None) -> int:
-    cfg = ExporterConfig.from_args(argv)
+    # --watch is status-only; everything else is the shared exporter flag set.
+    pre = argparse.ArgumentParser(add_help=False)
+    pre.add_argument("--watch", type=float, default=0.0,
+                     help="re-render every N seconds until interrupted")
+    ns, rest = pre.parse_known_args(argv)
+    cfg = ExporterConfig.from_args(rest)
     topo = detect_host_topology(
         accelerator=cfg.accelerator, slice_name=cfg.slice_name,
         host=cfg.node_name, worker_id=cfg.worker_id,
     )
     backend = build_backend(cfg)
     attribution = build_attribution(cfg)
+    scanner = None
+    if cfg.process_metrics:
+        from tpu_pod_exporter.procscan import ProcScanner
+
+        scanner = ProcScanner(
+            proc_root=cfg.proc_root,
+            full_scan_every=cfg.process_full_scan_every,
+        )
     try:
-        return _run(cfg, topo, backend, attribution)
+        if ns.watch <= 0:
+            return _run(cfg, topo, backend, attribution, scanner)
+        while True:
+            # ANSI home+clear keeps the table in place like `watch`/tpu-info.
+            print("\x1b[H\x1b[2J", end="")
+            rc = _run(cfg, topo, backend, attribution, scanner)
+            if rc != 0:
+                return rc
+            time.sleep(ns.watch)
+    except KeyboardInterrupt:
+        return 0
     finally:
         backend.close()
         attribution.close()
 
 
-def _run(cfg, topo, backend, attribution) -> int:
+def _run(cfg, topo, backend, attribution, scanner=None) -> int:
     try:
         sample = backend.sample()
     except BackendError as e:
@@ -78,6 +105,14 @@ def _run(cfg, topo, backend, attribution) -> int:
         print("no TPU chips found on this host")
         return 0
 
+    holders_by_path: dict[str, list] = {}
+    if scanner is not None:
+        try:
+            for h in scanner.scan():
+                holders_by_path.setdefault(h.device_path, []).append(h)
+        except Exception as e:  # noqa: BLE001 — status stays useful without it
+            print(f"(process scan unavailable: {e})", file=sys.stderr)
+
     rows = []
     pods: dict[tuple[str, str], list[float]] = {}
     for chip in sample.chips:
@@ -96,19 +131,28 @@ def _run(cfg, topo, backend, attribution) -> int:
             if chip.hbm_total_bytes
             else "-"
         )
-        rows.append([
+        row = [
             chip.info.chip_id,
             chip.info.device_path or "-",
             f"{fmt_bytes(chip.hbm_used_bytes)}/{fmt_bytes(chip.hbm_total_bytes)}",
             pct,
             duty,
             f"{owner.namespace}/{owner.pod}" if owner else "-",
-        ])
+        ]
+        if scanner is not None:
+            chip_holders = holders_by_path.get(chip.info.device_path, [])
+            row.append(
+                ",".join(f"{h.pid}/{h.comm}" for h in chip_holders) or "-"
+            )
+        rows.append(row)
         if owner:
             agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
             agg[0] += 1
             agg[1] += chip.hbm_used_bytes
-    print(render_table(rows, ["chip", "device", "hbm", "hbm%", "duty", "pod"]))
+    header = ["chip", "device", "hbm", "hbm%", "duty", "pod"]
+    if scanner is not None:
+        header.append("holder")
+    print(render_table(rows, header))
 
     if pods:
         print()
