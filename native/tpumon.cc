@@ -22,6 +22,7 @@
 
 #include <dirent.h>
 #include <sys/stat.h>
+#include <unistd.h>
 
 namespace {
 
@@ -220,7 +221,136 @@ long tpumon_render2(const char** prefixes, const int* plens,
   return used;
 }
 
+// Scan proc_root for processes holding device files whose readlink target
+// starts with one of the newline-separated `prefixes`. Writes one record per
+// (pid, device) pair into out: "pid\tdevice\tcomm\n" (comm sanitized: tabs/
+// newlines replaced). The hot part of the exporter's process-attribution
+// full scan — O(processes × fds) readlinks — kept native so a busy node's
+// /proc walk stays off the Python interpreter (SURVEY.md §2.7 ledger;
+// per-holder cgroup identity stays in the Python caller, holders are few).
+//
+// Returns the pair count on success (which may exceed what fit: caller
+// compares against what it parsed and grows the buffer), -1 on bad args or
+// unreadable proc_root (caller must treat as scan *failure*, not empty).
+long tpumon_scan_proc(const char* proc_root, const char* prefixes,
+                      char* out, long cap) {
+  if (proc_root == nullptr || prefixes == nullptr || out == nullptr || cap <= 0)
+    return -1;
+  DIR* proc = opendir(proc_root);
+  if (proc == nullptr) return -1;
+
+  // Split prefixes once into (ptr, len) pairs; cap at 16 prefixes.
+  const char* pfx[16];
+  int pfx_len[16];
+  int npfx = 0;
+  for (const char* p = prefixes; *p && npfx < 16;) {
+    const char* nl = std::strchr(p, '\n');
+    int len = nl ? (int)(nl - p) : (int)std::strlen(p);
+    if (len > 0) {
+      pfx[npfx] = p;
+      pfx_len[npfx] = len;
+      ++npfx;
+    }
+    p = nl ? nl + 1 : p + len;
+  }
+
+  long count = 0;
+  long used = 0;
+  out[0] = '\0';
+  struct dirent* pe;
+  while ((pe = readdir(proc)) != nullptr) {
+    if (!is_all_digits(pe->d_name)) continue;
+
+    char fd_dir[4352];
+    std::snprintf(fd_dir, sizeof(fd_dir), "%s/%s/fd", proc_root, pe->d_name);
+    DIR* fds = opendir(fd_dir);
+    if (fds == nullptr) continue;  // exited / unreadable: normal, skip
+
+    // Per-process device dedupe (a process rarely holds >16 devices; extra
+    // fds to the same device are the common case instead). A process that
+    // genuinely exceeds the cap makes the whole scan return -1 so the
+    // caller's (unbounded) Python walk takes over — silently truncating here
+    // would make the verify path disagree with the cache forever.
+    char devs[16][256];
+    int ndevs = 0;
+    bool overflow = false;
+    struct dirent* fe;
+    while ((fe = readdir(fds)) != nullptr) {
+      if (fe->d_name[0] == '.') continue;
+      char link_path[4608];
+      std::snprintf(link_path, sizeof(link_path), "%s/%s", fd_dir, fe->d_name);
+      char target[256];
+      ssize_t tlen = readlink(link_path, target, sizeof(target) - 1);
+      if (tlen <= 0) continue;
+      target[tlen] = '\0';
+      // "/dev/accel0 (deleted)" → "/dev/accel0" (recreated node, wedged
+      // holder — exactly what the metric exists to expose).
+      const char kDeleted[] = " (deleted)";
+      size_t dlen = sizeof(kDeleted) - 1;
+      if ((size_t)tlen > dlen &&
+          std::strcmp(target + tlen - dlen, kDeleted) == 0)
+        target[tlen - dlen] = '\0';
+      bool match = false;
+      for (int i = 0; i < npfx && !match; ++i)
+        match = std::strncmp(target, pfx[i], pfx_len[i]) == 0;
+      if (!match) continue;
+      bool dup = false;
+      for (int i = 0; i < ndevs && !dup; ++i)
+        dup = std::strcmp(devs[i], target) == 0;
+      if (dup) continue;
+      if (ndevs == 16) {
+        overflow = true;
+        break;
+      }
+      std::snprintf(devs[ndevs++], sizeof(devs[0]), "%s", target);
+    }
+    closedir(fds);
+    if (overflow) {
+      closedir(proc);
+      return -1;
+    }
+    if (ndevs == 0) continue;
+
+    // comm, sanitized to match the Python scanner byte-for-byte (the verify
+    // path compares Python-scanned holders against this cache): trim
+    // leading/trailing ASCII whitespace, then '?'-replace interior tab and
+    // newline (the record separators).
+    char comm[64] = "";
+    char comm_path[4352];
+    std::snprintf(comm_path, sizeof(comm_path), "%s/%s/comm", proc_root,
+                  pe->d_name);
+    FILE* cf = std::fopen(comm_path, "re");
+    if (cf != nullptr) {
+      char raw[64];
+      size_t n = std::fread(raw, 1, sizeof(raw) - 1, cf);
+      std::fclose(cf);
+      raw[n] = '\0';
+      size_t start = 0;
+      while (start < n && std::strchr(" \t\n\r\v\f", raw[start]) != nullptr &&
+             raw[start] != '\0')
+        ++start;
+      while (n > start && std::strchr(" \t\n\r\v\f", raw[n - 1]) != nullptr &&
+             raw[n - 1] != '\0')
+        --n;
+      std::memcpy(comm, raw + start, n - start);
+      comm[n - start] = '\0';
+      for (char* c = comm; *c; ++c)
+        if (*c == '\t' || *c == '\n') *c = '?';
+    }
+
+    for (int i = 0; i < ndevs; ++i) {
+      ++count;
+      int n = std::snprintf(out + used, cap > used ? cap - used : 0,
+                            "%s\t%s\t%s\n", pe->d_name, devs[i], comm);
+      if (n > 0 && used + n < cap) used += n;
+    }
+  }
+  closedir(proc);
+  if (cap > 0) out[used < cap ? used : cap - 1] = '\0';
+  return count;
+}
+
 // ABI version for the ctypes loader to sanity-check.
-int tpumon_abi_version(void) { return 2; }
+int tpumon_abi_version(void) { return 3; }
 
 }  // extern "C"

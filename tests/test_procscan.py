@@ -319,3 +319,63 @@ class TestCollectorIntegration:
         c.poll_once()
         text = store.current().encode().decode()
         assert "tpu_chip_process_info" not in text
+
+
+class TestNativeParity:
+    def _tree(self, tmp_path):
+        add_proc(tmp_path, 100, ["/dev/accel0", "/dev/accel0", "/dev/accel1"])
+        add_proc(tmp_path, 205, ["/dev/accel2 (deleted)"], comm="wedged",
+                 cgroup=CGROUP_V1)
+        add_proc(tmp_path, 30, ["/dev/vfio/7"], cgroup=CGROUP_NON_POD)
+        add_proc(tmp_path, 40, ["/dev/null"])  # not a holder
+        (tmp_path / "not-a-pid").mkdir()
+
+    def test_native_and_python_full_scans_agree(self, tmp_path):
+        from tpu_pod_exporter import nativelib
+
+        self._tree(tmp_path)
+        s = ProcScanner(proc_root=str(tmp_path))
+        if nativelib.load() is None:
+            pytest.skip("native lib unavailable")
+        native_found = s._native_full_scan()
+        assert native_found is not None
+        python_found = s._python_full_scan()
+        assert native_found == python_found
+        assert sorted(native_found) == [30, 100, 205]
+
+    def test_python_fallback_when_native_unavailable(self, tmp_path, monkeypatch):
+        from tpu_pod_exporter import nativelib
+
+        self._tree(tmp_path)
+        monkeypatch.setattr(nativelib, "load", lambda: None)
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert sorted({h.pid for h in holders}) == [30, 100, 205]
+        assert [h.device_path for h in holders if h.pid == 205] == ["/dev/accel2"]
+
+    def test_weird_comm_parity(self, tmp_path):
+        # prctl lets a process set comm to nearly anything; both scanners
+        # must sanitize identically or the verify cache thrashes.
+        from tpu_pod_exporter import nativelib
+
+        add_proc(tmp_path, 90, ["/dev/accel0"], comm="a\rb")
+        add_proc(tmp_path, 91, ["/dev/accel1"], comm="\tworker ")
+        add_proc(tmp_path, 92, ["/dev/accel2"], comm="odd\tname")
+        s = ProcScanner(proc_root=str(tmp_path))
+        python_found = s._python_full_scan()
+        if nativelib.load() is not None:
+            native_found = s._native_full_scan()
+            assert native_found == python_found
+        comms = {pid: hs[0].comm for pid, hs in python_found.items()}
+        assert comms == {90: "a\rb", 91: "worker", 92: "odd?name"}
+
+    def test_native_overflow_falls_back_to_python(self, tmp_path):
+        # >16 distinct matching devices in one process: native must refuse
+        # (-1) rather than truncate, and scan() must still return the truth.
+        from tpu_pod_exporter import nativelib
+
+        add_proc(tmp_path, 95, [f"/dev/accel{i}" for i in range(20)])
+        s = ProcScanner(proc_root=str(tmp_path))
+        if nativelib.load() is not None:
+            assert s._native_full_scan() is None
+        holders = s.scan()
+        assert len(holders) == 20

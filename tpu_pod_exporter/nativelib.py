@@ -17,7 +17,7 @@ from pathlib import Path
 
 log = logging.getLogger("tpu_pod_exporter.nativelib")
 
-ABI_VERSION = 2
+ABI_VERSION = 3
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -70,6 +70,13 @@ def load() -> ctypes.CDLL | None:
                     ctypes.POINTER(ctypes.c_int),
                     ctypes.POINTER(ctypes.c_double),
                     ctypes.c_long,
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                ]
+                lib.tpumon_scan_proc.restype = ctypes.c_long
+                lib.tpumon_scan_proc.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_char_p,
                     ctypes.c_char_p,
                     ctypes.c_long,
                 ]

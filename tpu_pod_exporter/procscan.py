@@ -142,6 +142,16 @@ class ProcScanner:
         return self._full_scan()
 
     def _full_scan(self) -> tuple[DeviceHolder, ...]:
+        found = self._native_full_scan()
+        if found is None:
+            found = self._python_full_scan()
+        self.full_scans += 1
+        self._scans_since_full = 0
+        self._has_scanned = True
+        self._cached = found
+        return self._flatten(found)
+
+    def _python_full_scan(self) -> dict[int, tuple[DeviceHolder, ...]]:
         try:
             entries = os.listdir(self._proc_root)
         except OSError as e:
@@ -149,9 +159,6 @@ class ProcScanner:
             # cache or reset the verify window, or recovery would trust a
             # bogus empty set for another full_scan_every polls.
             raise ProcScanError(f"proc root {self._proc_root!r} unreadable: {e}") from e
-        self.full_scans += 1
-        self._scans_since_full = 0
-        self._has_scanned = True
         found: dict[int, tuple[DeviceHolder, ...]] = {}
         for entry in entries:
             if not entry.isdigit():
@@ -160,8 +167,69 @@ class ProcScanner:
             holders = self._scan_pid(pid)
             if holders:
                 found[pid] = holders
-        self._cached = found
-        return self._flatten(found)
+        return found
+
+    def _native_full_scan(self) -> dict[int, tuple[DeviceHolder, ...]] | None:
+        """Walk /proc via libtpumon (the O(processes × fds) readlink loop is
+        the scan's entire cost on a busy node). Returns None when the native
+        library is unavailable or disagrees structurally — the Python walk is
+        always a correct fallback. Per-holder cgroup identity is read here in
+        Python: holders are few, the walk is what's hot."""
+        from tpu_pod_exporter import nativelib
+
+        lib = nativelib.load()
+        if lib is None:
+            return None
+        prefixes = "\n".join(self._prefixes).encode()
+        root = self._proc_root.encode()
+        cap = 64 * 1024
+        import ctypes
+
+        while True:
+            buf = ctypes.create_string_buffer(cap)
+            n = lib.tpumon_scan_proc(root, prefixes, buf, cap)
+            if n < 0:
+                if not os.path.isdir(self._proc_root):
+                    raise ProcScanError(
+                        f"proc root {self._proc_root!r} unreadable"
+                    )
+                # Readable root but native scan refused: fall back.
+                return None
+            # Split on '\n' ONLY: splitlines() also breaks on \r/\v/\f/U+0085,
+            # which can legally appear inside a comm and would desync the
+            # record-count handshake below.
+            records = [
+                r for r in buf.value.decode("utf-8", errors="replace").split("\n") if r
+            ]
+            if len(records) == n or cap >= 16 * 1024 * 1024:
+                break
+            cap *= 4  # truncated: grow and rescan
+        by_pid: dict[int, list[str]] = {}
+        comms: dict[int, str] = {}
+        for rec in records:
+            parts = rec.split("\t")
+            if len(parts) != 3 or not parts[0].isdigit():
+                continue
+            pid = int(parts[0])
+            by_pid.setdefault(pid, []).append(parts[1])
+            comms[pid] = parts[2]
+        found: dict[int, tuple[DeviceHolder, ...]] = {}
+        for pid, paths in by_pid.items():
+            base = os.path.join(self._proc_root, str(pid))
+            pod_uid, container_id = parse_cgroup_identity(
+                self._read_text(os.path.join(base, "cgroup"))
+            )
+            found[pid] = tuple(
+                DeviceHolder(
+                    pid=pid,
+                    comm=comms[pid],
+                    device_path=dp,
+                    pod_uid=pod_uid,
+                    container_id=container_id,
+                )
+                for dp in sorted(set(paths))
+            )
+        return found
 
     def _scan_pid(self, pid: int) -> tuple[DeviceHolder, ...]:
         """One process's device-file holds; () on any per-process failure
@@ -188,7 +256,19 @@ class ProcScanner:
             return ()
         if not device_paths:
             return ()
-        comm = self._read_text(os.path.join(base, "comm")).strip()
+        # Sanitized identically to the native scanner's record format (which
+        # uses tab/newline separators): parity matters because the verify
+        # path compares Python-scanned holders against native-scanned cache
+        # entries — any formatting drift would force a full rescan per poll.
+        # Trim the explicit ASCII whitespace set (NOT .strip(), which also
+        # eats unicode whitespace the C side keeps), then '?'-replace the
+        # separators.
+        comm = (
+            self._read_text(os.path.join(base, "comm"))[:63]
+            .strip(" \t\n\r\v\f")
+            .replace("\t", "?")
+            .replace("\n", "?")
+        )
         pod_uid, container_id = parse_cgroup_identity(
             self._read_text(os.path.join(base, "cgroup"))
         )
@@ -206,7 +286,10 @@ class ProcScanner:
     @staticmethod
     def _read_text(path: str) -> str:
         try:
-            with open(path, encoding="utf-8", errors="replace") as f:
+            # newline="" disables universal-newline translation: a literal
+            # \r inside a comm must stay \r, byte-for-byte with the native
+            # scanner's raw read (verify-path parity).
+            with open(path, encoding="utf-8", errors="replace", newline="") as f:
                 return f.read()
         except OSError:
             return ""
