@@ -1,0 +1,285 @@
+"""Sequence-, pipeline-, and expert-parallel programs over a device mesh.
+
+Completes the loadgen's parallelism coverage beyond ``sharded.py``'s dp×tp
+step (SURVEY.md §2.8: the reference has *no* distributed component; here the
+distributed dimension is the *instrument* — each strategy produces a
+distinct, deterministic ICI traffic pattern the exporter's ``tpu_ici_*``
+metrics must observe):
+
+- **Ring attention** (sequence/context parallel): K/V blocks rotate around
+  the mesh via ``lax.ppermute`` while a flash-style running softmax
+  accumulates — neighbor-only ICI traffic, the long-context pattern.
+- **Pipeline parallel**: GPipe-style microbatch schedule; activations hop
+  stage→stage via ``ppermute`` — directional neighbor traffic with bubbles.
+- **Expert parallel (MoE)**: tokens ``lax.all_to_all`` to their expert's
+  device and back — the dense crossbar pattern.
+
+All three are ``jax.shard_map`` programs with compiler-visible collectives
+(no data-dependent Python control flow), verified numerically against their
+single-device references in ``tests/test_parallel.py`` on the virtual CPU
+mesh, and composed into the driver's multi-chip dry run
+(``__graft_entry__.dryrun_multichip``).
+"""
+
+from __future__ import annotations
+
+
+def _shard_map():
+    import jax
+
+    sm = getattr(jax, "shard_map", None)
+    if sm is None:  # jax < 0.8
+        from jax.experimental.shard_map import shard_map as sm
+    return sm
+
+
+def make_1d_mesh(n_devices: int, axis: str):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_pod_exporter.loadgen.sharded import pick_devices
+
+    return Mesh(np.array(pick_devices(n_devices)), axis_names=(axis,))
+
+
+# --------------------------------------------------------------------- ring
+
+def reference_attention(q, k, v):
+    """Plain softmax attention — the single-device ground truth. Dots pinned
+    to precision='highest': XLA's default dot lowering may round operands
+    (bf16-class) and a lossy reference would mask real defects."""
+    import jax.numpy as jnp
+
+    d = q.shape[-1]
+    scores = jnp.matmul(q, k.T, precision="highest") / jnp.sqrt(jnp.float32(d))
+    w = jnp.exp(scores - scores.max(axis=-1, keepdims=True))
+    w = w / w.sum(axis=-1, keepdims=True)
+    return jnp.matmul(w, v, precision="highest")
+
+
+def ring_attention_fn(mesh, axis: str = "seq"):
+    """shard_map program: q/k/v sharded along the sequence axis; K/V blocks
+    rotate ``n`` hops around the ring while a running (max, denominator)
+    softmax accumulates — numerically identical to full attention without
+    any device ever holding the whole sequence (the long-context recipe)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def local_block(q, k, v):
+        # q: (Tq, d) local queries; k/v: (Tkv, d) — one rotating block.
+        d = q.shape[-1]
+
+        def body(carry, _):
+            o, m, l, kb, vb = carry
+            s = (q @ kb.T) / jnp.sqrt(jnp.float32(d))      # (Tq, Tkv)
+            m_new = jnp.maximum(m, s.max(axis=-1))
+            corr = jnp.exp(m - m_new)                       # (Tq,)
+            p = jnp.exp(s - m_new[:, None])                 # (Tq, Tkv)
+            l = l * corr + p.sum(axis=-1)
+            o = o * corr[:, None] + p @ vb
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return (o, m_new, l, kb, vb), None
+
+        # Derive the initial carry from q so its device-varying provenance
+        # matches the loop outputs (jax ≥0.8 tracks varying manual axes).
+        o0 = jnp.zeros_like(q)
+        m0 = jnp.full_like(q[:, 0], -jnp.inf)
+        l0 = jnp.zeros_like(q[:, 0])
+        (o, _, l, _, _), _ = lax.scan(body, (o0, m0, l0, k, v), None, length=n)
+        return o / l[:, None]
+
+    sm = _shard_map()
+    seq_sharded = P(axis, None)
+    fn = sm(local_block, mesh=mesh,
+            in_specs=(seq_sharded, seq_sharded, seq_sharded),
+            out_specs=seq_sharded)
+    sharding = NamedSharding(mesh, seq_sharded)
+    return jax.jit(fn), sharding
+
+
+# ----------------------------------------------------------------- pipeline
+
+def pipeline_forward_fn(mesh, n_micro: int, axis: str = "stage"):
+    """GPipe-style pipeline: device ``i`` owns stage ``i``'s weights; each
+    tick every stage computes its microbatch and ppermutes the activation to
+    the next stage. ``n_micro + n_stages - 1`` ticks drain the schedule.
+
+    Returns ``fn(stage_w, xs) -> ys`` where ``stage_w`` is (n_stages, w, w)
+    sharded over the stage axis, ``xs`` is (n_micro, mb, w) replicated, and
+    ``ys`` is the pipeline output (replicated; every device returns it)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_stage = mesh.shape[axis]
+    # stage i sends to stage i+1 (no wraparound: directional traffic).
+    perm = [(i, i + 1) for i in range(n_stage - 1)]
+
+    def local(stage_w, xs):
+        # stage_w: (1, w, w) this stage's weights; xs: (n_micro, mb, w).
+        # The tick loop is a lax.scan, not a Python unroll: graph size stays
+        # O(1) in n_micro + n_stage (a 64-stage mesh would otherwise unroll
+        # ~190 matmul+ppermute ticks into one XLA program).
+        w = stage_w[0]
+        idx = lax.axis_index(axis)
+        mb, width = xs.shape[1], xs.shape[2]
+        # Pad the schedule's drain ticks so inject is dynamically indexable.
+        xs_pad = jnp.concatenate(
+            [xs, jnp.zeros((n_stage - 1, mb, width), xs.dtype)], axis=0
+        )
+        # Carries are written by device-varying computation, so their initial
+        # values must carry the same provenance (jax ≥0.8 vma rule). pcast is
+        # the current spelling; pvary the pre-0.8.1 one.
+        def _varying(a):
+            pcast = getattr(lax, "pcast", None)
+            if pcast is not None:
+                return pcast(a, (axis,), to="varying")
+            return lax.pvary(a, (axis,))
+
+        out0 = _varying(jnp.zeros_like(xs))
+        h0 = _varying(jnp.zeros((mb, width), xs.dtype))
+
+        def tick(carry, t):
+            out, h_recv = carry
+            inject = lax.dynamic_index_in_dim(xs_pad, t, keepdims=False)
+            h_in = jnp.where(idx == 0, inject, h_recv)
+            h_out = jnp.tanh(h_in @ w)
+            slot = t - (n_stage - 1)
+            # Only the last stage, and only during drain-valid ticks, writes
+            # its result; everyone else adds zeros to the clamped slot.
+            writes = (idx == n_stage - 1) & (slot >= 0)
+            contrib = jnp.where(writes, h_out, jnp.zeros_like(h_out))
+            out = out.at[jnp.maximum(slot, 0)].add(contrib)
+            h_recv = lax.ppermute(h_out, axis, perm)
+            return (out, h_recv), None
+
+        ticks = jnp.arange(n_micro + n_stage - 1)
+        (out, _), _ = lax.scan(tick, (out0, h0), ticks)
+        # out is populated only on the last stage; all-reduce replicates it.
+        return lax.psum(out, axis)
+
+    sm = _shard_map()
+    fn = sm(local, mesh=mesh,
+            in_specs=(P(axis, None, None), P()),
+            out_specs=P())
+    return jax.jit(fn), NamedSharding(mesh, P(axis, None, None))
+
+
+def reference_pipeline(stage_w, xs):
+    """Sequential application of every stage — ground truth (highest-precision
+    dots; see reference_attention)."""
+    import jax.numpy as jnp
+
+    h = xs  # (n_micro, mb, w)
+    for i in range(stage_w.shape[0]):
+        h = jnp.tanh(jnp.matmul(h, stage_w[i], precision="highest"))
+    return h
+
+
+# ---------------------------------------------------------------------- moe
+
+def moe_forward_fn(mesh, axis: str = "expert"):
+    """Expert-parallel MoE layer: device ``i`` owns expert ``i``. Local token
+    ``j`` routes deterministically to expert ``j % n_experts`` (position
+    routing keeps the program data-independent — the point is the
+    ``all_to_all`` dispatch/combine traffic, not a learned gate).
+
+    Returns ``fn(expert_w, x) -> y`` with ``expert_w`` (n_exp, d, d) sharded
+    over the expert axis and ``x`` (tokens, d) sharded over the same axis."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n_exp = mesh.shape[axis]
+
+    def local(expert_w, x):
+        # expert_w: (1, d, d); x: (t_local, d) with t_local % n_exp == 0.
+        w = expert_w[0]
+        t_local, d = x.shape
+        cap = t_local // n_exp
+        # Group local tokens by destination expert: token j → expert j%n_exp.
+        groups = x.reshape(cap, n_exp, d).transpose(1, 0, 2)  # (n_exp, cap, d)
+        # Dispatch: slot e goes to device e; receive one block per source.
+        recv = lax.all_to_all(groups, axis, split_axis=0, concat_axis=0)
+        hidden = jnp.tanh(recv.reshape(n_exp * cap, d) @ w)
+        # Combine: send each source's processed block home.
+        back = lax.all_to_all(hidden.reshape(n_exp, cap, d), axis,
+                              split_axis=0, concat_axis=0)
+        return back.transpose(1, 0, 2).reshape(t_local, d)
+
+    sm = _shard_map()
+    fn = sm(local, mesh=mesh,
+            in_specs=(P(axis, None, None), P(axis, None)),
+            out_specs=P(axis, None))
+    return jax.jit(fn), NamedSharding(mesh, P(axis, None, None)), NamedSharding(mesh, P(axis, None))
+
+
+def reference_moe(expert_w, x):
+    """Every token through its position-routed expert — ground truth."""
+    import jax.numpy as jnp
+
+    n_exp = expert_w.shape[0]
+    t = x.shape[0]
+    idx = jnp.arange(t) % n_exp
+    per_expert = jnp.einsum(
+        "td,edh->teh", x, expert_w, precision="highest"
+    )  # (t, n_exp, d)
+    return jnp.tanh(per_expert[jnp.arange(t), idx])
+
+
+# ------------------------------------------------------------------- dryrun
+
+def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
+    """Compile + execute one step of each strategy on an n-device mesh with
+    tiny shapes. Returns a finite checksum per strategy (the driver asserts
+    non-NaN); used by ``__graft_entry__.dryrun_multichip``."""
+    import jax
+    import jax.numpy as jnp
+
+    results: dict[str, float] = {}
+
+    # SP: ring attention over a "seq" ring.
+    mesh = make_1d_mesh(n_devices, "seq")
+    fn, sharding = ring_attention_fn(mesh)
+    t, d = 4 * n_devices, 8
+    key = jax.random.PRNGKey(0)
+    q = jax.device_put(jax.random.normal(key, (t, d), jnp.float32), sharding)
+    k = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) + 1, sharding)
+    v = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) - 1, sharding)
+    results["ring_attention"] = float(jnp.sum(fn(q, k, v)))
+
+    # PP: microbatched pipeline over a "stage" chain.
+    mesh = make_1d_mesh(n_devices, "stage")
+    n_micro = 2 * n_devices
+    fn, w_sharding = pipeline_forward_fn(mesh, n_micro=n_micro)
+    width, mb = 8, 4
+    stage_w = jax.device_put(
+        jax.random.normal(key, (n_devices, width, width), jnp.float32) * 0.5,
+        w_sharding,
+    )
+    xs = jax.random.normal(key, (n_micro, mb, width), jnp.float32)
+    results["pipeline"] = float(jnp.sum(fn(stage_w, xs)))
+
+    # EP: MoE all_to_all over an "expert" axis (own dims — each strategy
+    # block is self-contained).
+    mesh = make_1d_mesh(n_devices, "expert")
+    fn, w_sharding, x_sharding = moe_forward_fn(mesh)
+    d_moe = 8
+    tokens = n_devices * n_devices * 2  # t_local divisible by n_exp
+    expert_w = jax.device_put(
+        jax.random.normal(key, (n_devices, d_moe, d_moe), jnp.float32) * 0.5,
+        w_sharding,
+    )
+    x = jax.device_put(
+        jax.random.normal(key, (tokens, d_moe), jnp.float32), x_sharding
+    )
+    results["moe"] = float(jnp.sum(fn(expert_w, x)))
+    return results

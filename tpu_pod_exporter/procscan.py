@@ -180,6 +180,10 @@ class ProcScanner:
         lib = nativelib.load()
         if lib is None:
             return None
+        if len(self._prefixes) > 16:
+            # tpumon_scan_proc matches at most 16 prefixes; beyond that the
+            # native scan would silently miss holders — refuse it instead.
+            return None
         prefixes = "\n".join(self._prefixes).encode()
         root = self._proc_root.encode()
         cap = 64 * 1024
@@ -201,8 +205,14 @@ class ProcScanner:
             records = [
                 r for r in buf.value.decode("utf-8", errors="replace").split("\n") if r
             ]
-            if len(records) == n or cap >= 16 * 1024 * 1024:
+            if len(records) == n:
                 break
+            if cap >= 16 * 1024 * 1024:
+                # Still truncated at the ceiling: a partial holder set must
+                # not masquerade as the full one (dropped holders would
+                # vanish from metrics AND from the verify cache) — let the
+                # unbounded Python walk take over.
+                return None
             cap *= 4  # truncated: grow and rescan
         by_pid: dict[int, list[str]] = {}
         comms: dict[int, str] = {}
