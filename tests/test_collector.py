@@ -369,3 +369,67 @@ class TestSelfMetrics:
         assert snap.samples("tpu_hbm_used_bytes") == {}
         # families still declared for a stable scrape surface
         assert b"# TYPE tpu_hbm_used_bytes gauge" in snap.encode()
+
+
+class TestTelemetryDepth:
+    def test_peak_hbm_and_chip_info(self, store):
+        from tpu_pod_exporter.backend import ChipInfo
+
+        infos = [
+            ChipInfo(chip_id=0, device_path="/dev/accel0",
+                     device_kind="TPU v5p", coords="0,0,0"),
+            ChipInfo(chip_id=1, device_path="/dev/accel1",
+                     device_kind="TPU v5p", coords="1,0,0"),
+        ]
+        script = FakeChipScript(
+            hbm_total_bytes=100.0, hbm_used_bytes=10.0, hbm_peak_bytes=55.0
+        )
+        c = make_collector(FakeBackend(chips=infos, script=script),
+                           FakeAttribution(), store)
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_peak_bytes", chip_labels(0)) == 55.0
+        info_labels = dict(chip_labels(1), device_kind="TPU v5p", coords="1,0,0")
+        assert snap.value("tpu_chip_info", info_labels) == 1.0
+
+    def test_peak_and_info_absent_when_unknown(self, store, four_chip_backend):
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        c.poll_once()
+        text = store.current().encode().decode()
+        # Families declared (stable surface), but no samples.
+        assert "# TYPE tpu_hbm_peak_bytes gauge" in text
+        assert "\ntpu_hbm_peak_bytes{" not in text
+        assert "\ntpu_chip_info{" not in text
+
+    def test_self_usage_metrics(self, store, four_chip_backend):
+        import sys
+
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        c.poll_once()
+        snap = store.current()
+        cpu1 = snap.value("tpu_exporter_cpu_seconds_total")
+        rss = snap.value("tpu_exporter_rss_bytes")
+        if sys.platform == "linux":
+            # Documented absence behavior applies only off-Linux.
+            assert cpu1 is not None and cpu1 > 0
+            assert rss is not None and rss > 1024 * 1024  # a real process RSS
+        if cpu1 is not None:
+            c.poll_once()
+            assert store.current().value("tpu_exporter_cpu_seconds_total") >= cpu1
+
+    def test_peak_round_trips_through_recording(self, tmp_path, store):
+        from tpu_pod_exporter.backend import ChipInfo
+        from tpu_pod_exporter.backend.recorded import RecordedBackend, RecordingBackend
+
+        infos = [ChipInfo(chip_id=0, device_kind="TPU v4", coords="0,1,2")]
+        script = FakeChipScript(hbm_total_bytes=10.0, hbm_used_bytes=2.0,
+                                hbm_peak_bytes=7.0)
+        path = str(tmp_path / "t.jsonl")
+        rec = RecordingBackend(FakeBackend(chips=infos, script=script), path)
+        rec.sample()
+        rec.close()
+        replay = RecordedBackend(path)
+        chip = replay.sample().chips[0]
+        assert chip.hbm_peak_bytes == 7.0
+        assert chip.info.device_kind == "TPU v4"
+        assert chip.info.coords == "0,1,2"

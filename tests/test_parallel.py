@@ -71,7 +71,7 @@ def test_pipeline_matches_sequential_stages():
 
     mesh = make_1d_mesh(N, "stage")
     n_micro, mb, width = 2 * N, 4, 8
-    fn, w_sharding = pipeline_forward_fn(mesh, n_micro=n_micro)
+    fn, w_sharding = pipeline_forward_fn(mesh)
     stage_w = 0.5 * jax.random.normal(
         jax.random.PRNGKey(3), (N, width, width), jnp.float32
     )

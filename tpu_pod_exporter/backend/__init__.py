@@ -46,6 +46,10 @@ class ChipInfo:
     chip_id: int
     device_path: str = ""
     device_ids: tuple[str, ...] = ()
+    # Optional hardware identity, filled by backends that know it (jaxdev:
+    # Device.device_kind / .coords). Empty strings when unknown.
+    device_kind: str = ""
+    coords: str = ""  # torus position, e.g. "0,1,2"
 
     def __post_init__(self) -> None:
         if not self.device_ids:
@@ -73,6 +77,9 @@ class ChipSample(NamedTuple):
     hbm_total_bytes: float
     tensorcore_duty_cycle_percent: float | None = None
     ici_links: tuple[IciLinkSample, ...] = ()
+    # Allocator high-water mark since runtime start (jaxdev:
+    # memory_stats peak_bytes_in_use); None when the backend can't report it.
+    hbm_peak_bytes: float | None = None
 
 
 class HostSample(NamedTuple):

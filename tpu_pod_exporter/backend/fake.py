@@ -38,6 +38,7 @@ class FakeChipScript:
 
     hbm_total_bytes: float = DEFAULT_HBM_TOTAL
     hbm_used_bytes: float | Callable[[int], float] = 0.0
+    hbm_peak_bytes: float | Callable[[int], float] | None = None
     duty_cycle_percent: float | Callable[[int], float] | None = 0.0
     ici_link_count: int = 6  # 3D torus: ±x, ±y, ±z  [design]
     # cumulative bytes per link per poll step
@@ -60,12 +61,16 @@ class FakeChipScript:
         links = tuple(
             IciLinkSample(ids[li], total) for li in range(self.ici_link_count)
         )
+        peak = None
+        if self.hbm_peak_bytes is not None:
+            peak = self._resolve(self.hbm_peak_bytes, step)
         return ChipSample(
             info=info,
             hbm_used_bytes=self._resolve(self.hbm_used_bytes, step),
             hbm_total_bytes=self.hbm_total_bytes,
             tensorcore_duty_cycle_percent=duty,
             ici_links=links,
+            hbm_peak_bytes=peak,
         )
 
 

@@ -56,6 +56,7 @@ class JaxDeviceBackend(DeviceBackend):
         for d in devices:
             used = 0.0
             total = 0.0
+            peak = None
             try:
                 stats = d.memory_stats()
                 if stats is None:  # some runtimes (tunnels, CPU) expose none
@@ -65,18 +66,24 @@ class JaxDeviceBackend(DeviceBackend):
                 total = float(
                     stats.get("bytes_limit", stats.get("bytes_reservable_limit", 0))
                 )
+                if "peak_bytes_in_use" in stats:
+                    peak = float(stats["peak_bytes_in_use"])
             except Exception as e:  # noqa: BLE001 — CPU devices raise; report once
                 partial.append(f"device {d.id}: memory_stats unavailable: {e}")
+            coords = getattr(d, "coords", None)
             chips.append(
                 ChipSample(
                     info=ChipInfo(
                         chip_id=int(d.id),
                         device_path="",
                         device_ids=(str(d.id),),
+                        device_kind=getattr(d, "device_kind", "") or "",
+                        coords=",".join(str(c) for c in coords) if coords else "",
                     ),
                     hbm_used_bytes=used,
                     hbm_total_bytes=total,
                     tensorcore_duty_cycle_percent=None,  # not exposed via JAX
+                    hbm_peak_bytes=peak,
                 )
             )
         return HostSample(chips=tuple(chips), partial_errors=tuple(partial))

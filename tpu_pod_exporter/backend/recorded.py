@@ -7,10 +7,12 @@ or hold-last). This turns one session against real hardware into a
 repeatable benchmark/regression input with genuine value distributions —
 something the reference has no equivalent for.
 
-JSONL schema (one poll per line):
+JSONL schema (one poll per line; optional keys are omitted when absent so
+old recordings replay unchanged):
     {"chips": [{"chip_id": 0, "device_path": "...", "device_ids": ["0"],
                 "hbm_used": N, "hbm_total": N, "duty": N|null,
-                "ici": {"0": N, ...}}, ...],
+                "ici": {"0": N, ...},
+                "peak": N?, "device_kind": "..."?, "coords": "..."?}, ...],
      "partial_errors": ["..."]}
 """
 
@@ -31,19 +33,26 @@ from tpu_pod_exporter.backend import (
 
 
 def sample_to_dict(sample: HostSample) -> dict:
+    chips = []
+    for c in sample.chips:
+        doc = {
+            "chip_id": c.info.chip_id,
+            "device_path": c.info.device_path,
+            "device_ids": list(c.info.device_ids),
+            "hbm_used": c.hbm_used_bytes,
+            "hbm_total": c.hbm_total_bytes,
+            "duty": c.tensorcore_duty_cycle_percent,
+            "ici": {l.link: l.transferred_bytes_total for l in c.ici_links},
+        }
+        if c.hbm_peak_bytes is not None:
+            doc["peak"] = c.hbm_peak_bytes
+        if c.info.device_kind:
+            doc["device_kind"] = c.info.device_kind
+        if c.info.coords:
+            doc["coords"] = c.info.coords
+        chips.append(doc)
     return {
-        "chips": [
-            {
-                "chip_id": c.info.chip_id,
-                "device_path": c.info.device_path,
-                "device_ids": list(c.info.device_ids),
-                "hbm_used": c.hbm_used_bytes,
-                "hbm_total": c.hbm_total_bytes,
-                "duty": c.tensorcore_duty_cycle_percent,
-                "ici": {l.link: l.transferred_bytes_total for l in c.ici_links},
-            }
-            for c in sample.chips
-        ],
+        "chips": chips,
         "partial_errors": list(sample.partial_errors),
     }
 
@@ -57,6 +66,8 @@ def sample_from_dict(doc: dict) -> HostSample:
                     chip_id=int(c["chip_id"]),
                     device_path=c.get("device_path", ""),
                     device_ids=tuple(c.get("device_ids") or [str(c["chip_id"])]),
+                    device_kind=c.get("device_kind", ""),
+                    coords=c.get("coords", ""),
                 ),
                 hbm_used_bytes=float(c["hbm_used"]),
                 hbm_total_bytes=float(c["hbm_total"]),
@@ -66,6 +77,9 @@ def sample_from_dict(doc: dict) -> HostSample:
                 ici_links=tuple(
                     IciLinkSample(link=str(k), transferred_bytes_total=float(v))
                     for k, v in sorted((c.get("ici") or {}).items())
+                ),
+                hbm_peak_bytes=(
+                    None if c.get("peak") is None else float(c["peak"])
                 ),
             )
         )

@@ -106,7 +106,8 @@ class Collector:
         self._last_holders: tuple | None = None
         self._last_holders_at: float = 0.0
         # (chip_id, owner pod/ns/container) -> (chip label tuple,
-        # {link id -> link label tuple}). Label tuples are invariant between
+        # {link id -> link label tuple}, chip-info label tuple or None).
+        # Label tuples are invariant between
         # churn events, so rebuilding + re-interning them per chip per poll
         # is the main Python cost of publish at 256 chips; cache and reuse.
         # The cached inner tuples also make the PrefixCache layout comparison
@@ -269,6 +270,8 @@ class Collector:
             hbm_used_s = b.series(schema.TPU_HBM_USED_BYTES)
             hbm_total_s = b.series(schema.TPU_HBM_TOTAL_BYTES)
             hbm_pct_s = b.series(schema.TPU_HBM_USED_PERCENT)
+            hbm_peak_s = b.series(schema.TPU_HBM_PEAK_BYTES)
+            chip_info_s = b.series(schema.TPU_CHIP_INFO)
             duty_s = b.series(schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT)
             ici_total_s = b.series(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL)
             ici_bw_s = b.series(schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND)
@@ -301,17 +304,22 @@ class Collector:
                 cached = label_cache.get(cache_key)
                 if cached is None:
                     # Pre-ordered to CHIP_LABELS.
-                    cached = (
-                        (
-                            str(info.chip_id),
-                            info.device_path,
-                            *self._topo_tuple,
-                            *cache_key[2:],
-                        ),
-                        {},
+                    chip_tuple = (
+                        str(info.chip_id),
+                        info.device_path,
+                        *self._topo_tuple,
+                        *cache_key[2:],
                     )
+                    # device_kind/coords are static per chip: build the
+                    # tpu_chip_info label tuple once here, not per poll.
+                    info_tuple = (
+                        chip_tuple + (info.device_kind, info.coords)
+                        if (info.device_kind or info.coords)
+                        else None
+                    )
+                    cached = (chip_tuple, {}, info_tuple)
                     label_cache[cache_key] = cached
-                chip_tuple, link_tuples = cached
+                chip_tuple, link_tuples, info_tuple = cached
                 link_recs = chip_state.get(info.chip_id)
                 if link_recs is None:
                     link_recs = chip_state[info.chip_id] = {}
@@ -323,8 +331,12 @@ class Collector:
                 hbm_pct_s[chip_tuple] = (
                     used / total_b * 100.0 if total_b > 0 else 0.0
                 )
+                if chip.hbm_peak_bytes is not None:
+                    hbm_peak_s[chip_tuple] = chip.hbm_peak_bytes
                 if chip.tensorcore_duty_cycle_percent is not None:
                     duty_s[chip_tuple] = chip.tensorcore_duty_cycle_percent
+                if info_tuple is not None:
+                    chip_info_s[info_tuple] = 1.0
 
                 for link in chip.ici_links:
                     raw = link.transferred_bytes_total
@@ -441,6 +453,18 @@ class Collector:
         )
         b.add(schema.TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS, self._wallclock())
 
+        # Self-resource accounting (<1% CPU budget, auditable in production).
+        try:
+            import resource
+
+            ru = resource.getrusage(resource.RUSAGE_SELF)
+            b.add(schema.TPU_EXPORTER_CPU_SECONDS_TOTAL, ru.ru_utime + ru.ru_stime)
+        except Exception:  # noqa: BLE001 — accounting must never fail a poll
+            pass
+        rss = self._read_rss_bytes()
+        if rss is not None:
+            b.add(schema.TPU_EXPORTER_RSS_BYTES, rss)
+
         # ICI counter state lives in self._chip_state (pruned above when it
         # outgrows its bound: vanished chips only, never live ones).
         # CounterStore now holds only the node-lifetime self-metric
@@ -449,6 +473,22 @@ class Collector:
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))
         self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
+
+    _PAGE_SIZE = None
+
+    @classmethod
+    def _read_rss_bytes(cls) -> float | None:
+        """Current RSS from /proc/self/statm (field 2, pages); None off-Linux."""
+        try:
+            with open("/proc/self/statm") as f:
+                pages = int(f.read().split()[1])
+            if cls._PAGE_SIZE is None:
+                import os
+
+                cls._PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+            return float(pages * cls._PAGE_SIZE)
+        except Exception:  # noqa: BLE001
+            return None
 
     def close(self) -> None:
         self._backend.close()

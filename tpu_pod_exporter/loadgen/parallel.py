@@ -105,7 +105,7 @@ def ring_attention_fn(mesh, axis: str = "seq"):
 
 # ----------------------------------------------------------------- pipeline
 
-def pipeline_forward_fn(mesh, n_micro: int, axis: str = "stage"):
+def pipeline_forward_fn(mesh, axis: str = "stage"):
     """GPipe-style pipeline: device ``i`` owns stage ``i``'s weights; each
     tick every stage computes its microbatch and ppermutes the activation to
     the next stage. ``n_micro + n_stages - 1`` ticks drain the schedule.
@@ -124,6 +124,9 @@ def pipeline_forward_fn(mesh, n_micro: int, axis: str = "stage"):
 
     def local(stage_w, xs):
         # stage_w: (1, w, w) this stage's weights; xs: (n_micro, mb, w).
+        # n_micro comes from xs itself (static at trace time): a separately
+        # configured count could silently drop or duplicate microbatches.
+        n_micro = xs.shape[0]
         # The tick loop is a lax.scan, not a Python unroll: graph size stays
         # O(1) in n_micro + n_stage (a 64-stage mesh would otherwise unroll
         # ~190 matmul+ppermute ticks into one XLA program).
@@ -259,7 +262,7 @@ def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
     # PP: microbatched pipeline over a "stage" chain.
     mesh = make_1d_mesh(n_devices, "stage")
     n_micro = 2 * n_devices
-    fn, w_sharding = pipeline_forward_fn(mesh, n_micro=n_micro)
+    fn, w_sharding = pipeline_forward_fn(mesh)
     width, mb = 8, 4
     stage_w = jax.device_put(
         jax.random.normal(key, (n_devices, width, width), jnp.float32) * 0.5,

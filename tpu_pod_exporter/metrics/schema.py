@@ -70,6 +70,22 @@ TPU_HBM_USED_PERCENT = MetricSpec(
     label_names=CHIP_LABELS,
 )
 
+TPU_HBM_PEAK_BYTES = MetricSpec(
+    name="tpu_hbm_peak_bytes",
+    help="Allocator high-water mark of HBM use on this chip since runtime start (absent when the backend cannot report it).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+# Hardware identity, emitted when the backend knows it (jaxdev reports
+# device_kind and torus coords; the libtpu metrics service does not).
+TPU_CHIP_INFO = MetricSpec(
+    name="tpu_chip_info",
+    help="Static chip identity; value is always 1. coords is the chip's torus position (x,y,z).",
+    type=GAUGE,
+    label_names=CHIP_LABELS + ("device_kind", "coords"),
+)
+
 TPU_TENSORCORE_DUTY_CYCLE_PERCENT = MetricSpec(
     name="tpu_tensorcore_duty_cycle_percent",
     help="Percent of time the chip's TensorCore was busy over the last sample window (0-100).",
@@ -190,6 +206,20 @@ TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS = MetricSpec(
     type=GAUGE,
 )
 
+# Self-resource accounting: the <1% node CPU budget (BASELINE.md) must be
+# auditable in production, not just in bench.py.
+TPU_EXPORTER_CPU_SECONDS_TOTAL = MetricSpec(
+    name="tpu_exporter_cpu_seconds_total",
+    help="Total user+system CPU time consumed by the exporter process.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_RSS_BYTES = MetricSpec(
+    name="tpu_exporter_rss_bytes",
+    help="Resident set size of the exporter process (absent when /proc/self/statm is unreadable).",
+    type=GAUGE,
+)
+
 TPU_EXPORTER_INFO = MetricSpec(
     name="tpu_exporter_info",
     help="Static exporter build/runtime info; value is always 1.",
@@ -223,6 +253,8 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_HBM_USED_BYTES,
     TPU_HBM_TOTAL_BYTES,
     TPU_HBM_USED_PERCENT,
+    TPU_HBM_PEAK_BYTES,
+    TPU_CHIP_INFO,
     TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
     TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
     TPU_ICI_TRANSFERRED_BYTES_TOTAL,
@@ -236,6 +268,8 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_POLLS_TOTAL,
     TPU_EXPORTER_SERIES,
     TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS,
+    TPU_EXPORTER_CPU_SECONDS_TOTAL,
+    TPU_EXPORTER_RSS_BYTES,
     TPU_EXPORTER_INFO,
 )
 
