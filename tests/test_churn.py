@@ -103,6 +103,14 @@ class TestChurn:
 
     def test_series_count_bounded_under_churn(self, churn_app):
         app, attr = churn_app
+        # Warm up past the startup snapshot: ICI bandwidth series exist only
+        # from the second sampled poll (a rate needs a dt window), so a
+        # scrape racing the first poll would skew the count by 32 series.
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            if "tpu_ici_link_bandwidth_bytes_per_second{" in scrape(app.port):
+                break
+            time.sleep(0.01)
         counts = []
         for g in range(50):
             attr.set_allocations(

@@ -58,8 +58,13 @@ class FakeChipScript:
         ids = self._LINK_IDS
         if self.ici_link_count > len(ids):
             ids = tuple(str(i) for i in range(self.ici_link_count))
+        # tuple.__new__ bypasses the generated NamedTuple __new__ (a Python
+        # function): at bench scale (256 chips × 6 links × 1 s) the fake's
+        # own construction cost must stay out of the exporter's CPU budget.
+        mk = tuple.__new__
         links = tuple(
-            IciLinkSample(ids[li], total) for li in range(self.ici_link_count)
+            mk(IciLinkSample, (ids[li], total))
+            for li in range(self.ici_link_count)
         )
         peak = None
         if self.hbm_peak_bytes is not None:
