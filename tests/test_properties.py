@@ -97,3 +97,32 @@ class TestCounterMonotonicity:
             out = c.inc("n", (), d)
             assert out >= prev
             prev = out
+
+
+class TestOwnParserRoundtrip:
+    """Our renderer → OUR parser (metrics/parse.py, the aggregator's input
+    path) must agree for any label value and any float — the same invariant
+    the prometheus_client parser locks above, now for the in-house parser."""
+
+    @given(value=label_values, metric_value=finite_floats)
+    @settings(max_examples=200, deadline=None)
+    def test_any_label_value_roundtrips_through_own_parser(self, value, metric_value):
+        from tpu_pod_exporter.metrics.parse import parse_exposition
+
+        spec = MetricSpec(name="m", help="h", label_names=("l",))
+        b = SnapshotBuilder()
+        b.add(spec, metric_value, (value,))
+        text = b.build().encode().decode()
+        (sample,) = parse_exposition(text)
+        assert sample.labels["l"] == value
+        assert sample.value == metric_value or (
+            math.isnan(sample.value) and math.isnan(metric_value)
+        )
+
+    @given(v=st.floats(width=64))
+    @settings(max_examples=200, deadline=None)
+    def test_every_float_roundtrips_through_own_parser(self, v):
+        from tpu_pod_exporter.metrics.parse import parse_exposition
+
+        (sample,) = parse_exposition(f"m {format_value(v)}\n")
+        assert sample.value == v or (math.isnan(sample.value) and math.isnan(v))

@@ -82,3 +82,36 @@ class TestStatusCli:
         ])
         assert rc == 0
         assert "/dev/accel0" in out
+
+    def test_json_output(self, run_status, tmp_path):
+        import json
+        import os
+
+        d = tmp_path / "42" / "fd"
+        d.mkdir(parents=True)
+        os.symlink("/dev/accel0", d / "3")
+        (tmp_path / "42" / "comm").write_text("w\n")
+        (tmp_path / "42" / "cgroup").write_text("0::/x\n")
+        rc, out, _ = run_status([
+            "--backend", "fake", "--fake-chips", "2", "--attribution", "none",
+            "--accelerator", "v4-8", "--json",
+            "--process-metrics", "--proc-root", str(tmp_path),
+        ])
+        assert rc == 0
+        doc = json.loads(out)
+        assert doc["accelerator"] == "v4-8"
+        assert len(doc["chips"]) == 2
+        chip0 = doc["chips"][0]
+        assert chip0["device_path"] == "/dev/accel0"
+        assert chip0["holders"] == [{"pid": 42, "comm": "w", "pod_uid": ""}]
+        assert doc["pods"] == []
+
+    def test_json_zero_chips(self, run_status):
+        import json
+
+        rc, out, _ = run_status([
+            "--backend", "fake", "--fake-chips", "0", "--attribution", "none",
+            "--json",
+        ])
+        assert rc == 0
+        assert json.loads(out)["chips"] == []

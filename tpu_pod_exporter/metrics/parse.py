@@ -64,8 +64,13 @@ def _parse_label_block(block: str, line: str) -> dict[str, str]:
 
 def parse_exposition(text: str) -> Iterator[ParsedSample]:
     """Yield every sample in an exposition body. ``# HELP``/``# TYPE``/other
-    comments are skipped; trailing timestamps are accepted and dropped."""
-    for raw in text.splitlines():
+    comments are skipped; trailing timestamps are accepted and dropped.
+
+    Lines split on ``\\n`` ONLY — ``str.splitlines()`` also breaks on
+    \\v/\\f/U+0085/U+2028…, all of which may legally appear *unescaped*
+    inside a label value (the exposition format escapes only ``\\n``,
+    ``\\"`` and ``\\\\``)."""
+    for raw in text.split("\n"):
         line = raw.strip()
         if not line or line.startswith("#"):
             continue

@@ -41,6 +41,9 @@ def main(argv=None) -> int:
     pre = argparse.ArgumentParser(add_help=False)
     pre.add_argument("--watch", type=float, default=0.0,
                      help="re-render every N seconds until interrupted")
+    pre.add_argument("--json", action="store_true",
+                     help="machine-readable output (one JSON object; with "
+                          "--watch, one compact JSON line per interval)")
     ns, rest = pre.parse_known_args(argv)
     cfg = ExporterConfig.from_args(rest)
     topo = detect_host_topology(
@@ -59,11 +62,17 @@ def main(argv=None) -> int:
         )
     try:
         if ns.watch <= 0:
-            return _run(cfg, topo, backend, attribution, scanner)
+            return _run(cfg, topo, backend, attribution, scanner, as_json=ns.json)
         while True:
-            # ANSI home+clear keeps the table in place like `watch`/tpu-info.
-            print("\x1b[H\x1b[2J", end="")
-            rc = _run(cfg, topo, backend, attribution, scanner)
+            if ns.json:
+                # JSONL stream: no ANSI escapes, one object per line, so
+                # `... --json --watch 5 | jq` works.
+                rc = _run(cfg, topo, backend, attribution, scanner,
+                          as_json="line")
+            else:
+                # ANSI home+clear keeps the table in place like `watch`.
+                print("\x1b[H\x1b[2J", end="")
+                rc = _run(cfg, topo, backend, attribution, scanner)
             if rc != 0:
                 return rc
             time.sleep(ns.watch)
@@ -74,7 +83,7 @@ def main(argv=None) -> int:
         attribution.close()
 
 
-def _run(cfg, topo, backend, attribution, scanner=None) -> int:
+def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
     try:
         sample = backend.sample()
     except BackendError as e:
@@ -90,7 +99,7 @@ def _run(cfg, topo, backend, attribution, scanner=None) -> int:
         print(f"(attribution unavailable: {e})", file=sys.stderr)
         owner_map = {}
 
-    if topo.accelerator:
+    if not as_json and topo.accelerator:
         st = topo.slice_topology
         extra = (
             f"  ({st.total_chips} chips / {st.num_hosts} hosts slice-wide)"
@@ -101,7 +110,7 @@ def _run(cfg, topo, backend, attribution, scanner=None) -> int:
             print(f"slice: {topo.slice_name or '-'}  worker: {topo.worker_id or '-'}  host: {topo.host}")
         print()
 
-    if not sample.chips:
+    if not sample.chips and not as_json:
         print("no TPU chips found on this host")
         return 0
 
@@ -114,6 +123,7 @@ def _run(cfg, topo, backend, attribution, scanner=None) -> int:
             print(f"(process scan unavailable: {e})", file=sys.stderr)
 
     rows = []
+    doc_chips = []
     pods: dict[tuple[str, str], list[float]] = {}
     for chip in sample.chips:
         owner = None
@@ -121,6 +131,30 @@ def _run(cfg, topo, backend, attribution, scanner=None) -> int:
             owner = owner_map.get(did)
             if owner:
                 break
+        if owner:
+            agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
+            agg[0] += 1
+            agg[1] += chip.hbm_used_bytes
+        if as_json:
+            chip_holders = holders_by_path.get(chip.info.device_path, [])
+            doc_chips.append({
+                "chip_id": chip.info.chip_id,
+                "device_path": chip.info.device_path,
+                "device_kind": chip.info.device_kind,
+                "coords": chip.info.coords,
+                "hbm_used_bytes": chip.hbm_used_bytes,
+                "hbm_total_bytes": chip.hbm_total_bytes,
+                "hbm_peak_bytes": chip.hbm_peak_bytes,
+                "duty_cycle_percent": chip.tensorcore_duty_cycle_percent,
+                "pod": owner.pod if owner else None,
+                "namespace": owner.namespace if owner else None,
+                "container": owner.container if owner else None,
+                "holders": [
+                    {"pid": h.pid, "comm": h.comm, "pod_uid": h.pod_uid}
+                    for h in chip_holders
+                ],
+            })
+            continue
         duty = (
             f"{chip.tensorcore_duty_cycle_percent:.1f}%"
             if chip.tensorcore_duty_cycle_percent is not None
@@ -149,6 +183,23 @@ def _run(cfg, topo, backend, attribution, scanner=None) -> int:
             agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
             agg[0] += 1
             agg[1] += chip.hbm_used_bytes
+    if as_json:
+        import json
+
+        print(json.dumps({
+            "accelerator": topo.accelerator,
+            "slice_name": topo.slice_name,
+            "host": topo.host,
+            "worker_id": topo.worker_id,
+            "chips": doc_chips,
+            "pods": [
+                {"namespace": ns_, "pod": pod, "chips": int(n),
+                 "hbm_used_bytes": hbm}
+                for (ns_, pod), (n, hbm) in sorted(pods.items())
+            ],
+        }, indent=None if as_json == "line" else 1))
+        return 0
+
     header = ["chip", "device", "hbm", "hbm%", "duty", "pod"]
     if scanner is not None:
         header.append("holder")
