@@ -244,3 +244,62 @@ class TestAggregatorOverHTTP:
             if server is not None:
                 server.stop()
             app.stop()
+
+
+class TestMultiSlice:
+    def test_two_slices_roll_up_independently(self):
+        """One aggregator scraping hosts of two different slices keeps their
+        rollups apart (slice identity comes from series labels, not config)."""
+        pages = {}
+        for sl, workers in (("slice-a", 2), ("slice-b", 1)):
+            for w in range(workers):
+                backend = FakeBackend(
+                    chips=4,
+                    script=FakeChipScript(hbm_total_bytes=10.0, hbm_used_bytes=1.0),
+                )
+                attr = FakeAttribution(
+                    [simple_allocation(f"job-{sl}", ["0", "1", "2", "3"], namespace="ml")]
+                )
+                topo = HostTopology(
+                    accelerator="v5p-64", slice_name=sl,
+                    host=f"{sl}-h{w}", worker_id=str(w),
+                )
+                store = SnapshotStore()
+                Collector(backend, attr, store, topology=topo).poll_once()
+                pages[f"{sl}-h{w}:8000"] = store.current().encode().decode()
+        agg_store = SnapshotStore()
+        SliceAggregator(
+            tuple(pages), agg_store, fetch=StaticFetch(pages)
+        ).poll_once()
+        snap = agg_store.current()
+        a = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        b_ = {"slice_name": "slice-b", "accelerator": "v5p-64"}
+        assert snap.value("tpu_slice_chip_count", a) == 8.0
+        assert snap.value("tpu_slice_chip_count", b_) == 4.0
+        assert snap.value("tpu_slice_hosts_reporting", a) == 2.0
+        assert snap.value("tpu_slice_hosts_reporting", b_) == 1.0
+        assert snap.value(
+            "tpu_workload_chip_count",
+            {"pod": "job-slice-a", "namespace": "ml", "slice_name": "slice-a"},
+        ) == 8.0
+
+
+class TestDefaultFetch:
+    def test_gzip_negotiated_and_decompressed(self):
+        """default_fetch must transparently handle the exporter's gzip path
+        (and servers that ignore Accept-Encoding)."""
+        from tpu_pod_exporter.aggregate import default_fetch
+
+        backend = FakeBackend(
+            chips=2, script=FakeChipScript(hbm_total_bytes=8.0, hbm_used_bytes=2.0)
+        )
+        store = SnapshotStore()
+        Collector(backend, FakeAttribution(), store).poll_once()
+        server = MetricsServer(store, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            text = default_fetch(f"127.0.0.1:{server.port}", timeout_s=5.0)
+            fams = parse_families(text)
+            assert len(fams["tpu_hbm_used_bytes"]) == 2
+        finally:
+            server.stop()

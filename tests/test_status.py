@@ -115,3 +115,31 @@ class TestStatusCli:
         ])
         assert rc == 0
         assert json.loads(out)["chips"] == []
+
+    def test_table_pod_rollup_counts_each_chip_once(self, run_status, tmp_path):
+        # Regression: the per-pod table once double-counted chips/HBM when
+        # the rollup block existed on both sides of the --json split.
+        import json
+
+        ckpt = tmp_path / "ckpt.json"
+        ckpt.write_text(json.dumps({
+            "Data": {
+                "PodDeviceEntries": [
+                    {
+                        "PodUID": "u-1",
+                        "ContainerName": "main",
+                        "ResourceName": "google.com/tpu",
+                        "DeviceIDs": ["0", "1"],
+                    }
+                ]
+            }
+        }))
+        rc, out, _ = run_status([
+            "--backend", "fake", "--fake-chips", "2",
+            "--attribution", "checkpoint", "--checkpoint-path", str(ckpt),
+        ])
+        assert rc == 0
+        pod_line = [l for l in out.splitlines() if "uid:u-1" in l and "GiB" not in l]
+        # pods table row: "<ns>/<pod>  <chips>  <hbm>"
+        assert any(" 2 " in l or l.rstrip().endswith("2  0B") or "  2  " in l
+                   for l in pod_line), out

@@ -39,10 +39,21 @@ log = logging.getLogger("tpu_pod_exporter.aggregate")
 
 
 def default_fetch(target: str, timeout_s: float) -> str:
-    """``host:port`` (or full URL) → exposition text."""
+    """``host:port`` (or full URL) → exposition text.
+
+    Asks for gzip: the exporters serve a lazily-cached compressed body
+    (~20× smaller than the ~900 KB plain text at 256 chips), which matters
+    when the aggregator scrapes every host of a slice over DCN each round.
+    """
     url = target if target.startswith(("http://", "https://")) else f"http://{target}/metrics"
-    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied targets
-        return resp.read().decode("utf-8", errors="replace")
+    req = urllib.request.Request(url, headers={"Accept-Encoding": "gzip"})
+    with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied targets
+        body = resp.read()
+        if (resp.headers.get("Content-Encoding") or "").lower() == "gzip":
+            import gzip
+
+            body = gzip.decompress(body)
+        return body.decode("utf-8", errors="replace")
 
 
 class _SliceAgg:

@@ -179,10 +179,6 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
                 ",".join(f"{h.pid}/{h.comm}" for h in chip_holders) or "-"
             )
         rows.append(row)
-        if owner:
-            agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
-            agg[0] += 1
-            agg[1] += chip.hbm_used_bytes
     if as_json:
         import json
 
@@ -197,7 +193,7 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
                  "hbm_used_bytes": hbm}
                 for (ns_, pod), (n, hbm) in sorted(pods.items())
             ],
-        }, indent=None if as_json == "line" else 1))
+        }, indent=None if as_json == "line" else 1), flush=True)
         return 0
 
     header = ["chip", "device", "hbm", "hbm%", "duty", "pod"]
