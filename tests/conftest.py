@@ -1,63 +1,52 @@
 """Test harness setup (SURVEY.md §4).
 
 JAX-touching tests (loadgen, sharding) run on a virtual 8-device CPU mesh so
-multi-chip code paths execute with zero TPU hardware. These env vars must be
-set before jax is first imported anywhere in the test process.
+multi-chip code paths execute with zero TPU hardware.
+
+Platform pinning is two-layer because of this machine's sitecustomize hook
+(see ``tpu_pod_exporter.jaxenv``): the hook imports jax at interpreter start
+and force-sets ``jax_platforms="axon,cpu"``, so exporting
+``JAX_PLATFORMS=cpu`` alone is ignored and any ``jax.devices()`` call —
+including ``jax.devices("cpu")`` — would initialize the experimental
+TPU-tunnel backend and could hang pytest forever (round 1: 17 always-firing
+skips). ``pin_cpu_inprocess`` re-updates the already-imported jax config
+*before any backend init*, which restores a pure 8-device CPU world
+in-process — the numeric suites then run everywhere, hardware or not.
 """
-
-import os
-
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
-import subprocess  # noqa: E402
-
 import pytest  # noqa: E402
 
 from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation  # noqa: E402
 from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript  # noqa: E402
+from tpu_pod_exporter.jaxenv import pin_cpu_inprocess  # noqa: E402
 from tpu_pod_exporter.metrics import SnapshotStore  # noqa: E402
 
 _jax_ok: bool | None = None
 
 
 def jax_usable() -> bool:
-    """Probe JAX in a killable subprocess.
-
-    On this machine an experimental TPU-tunnel plugin initializes during
-    backend discovery and can hang the entire process (even
-    ``jax.devices('cpu')``) when the tunnel is wedged. An in-process probe
-    would hang pytest itself, so probe from a subprocess with a hard
-    timeout and skip all JAX-dependent tests when it fails — exporter tests
-    must stay green with no (working) accelerator runtime at all.
-    """
+    """Pin this process to an 8-device CPU JAX, once; True on success."""
     global _jax_ok
     if _jax_ok is None:
-        try:
-            proc = subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices('cpu')"],
-                timeout=60,
-                capture_output=True,
-                env={**os.environ},
-            )
-            _jax_ok = proc.returncode == 0
-        except subprocess.TimeoutExpired:
-            _jax_ok = False
+        _jax_ok = pin_cpu_inprocess(8)
     return _jax_ok
 
 
 def require_jax():
     if not jax_usable():
-        pytest.skip("jax runtime unavailable or hung (TPU tunnel wedge)")
+        pytest.skip("jax missing or already initialized on a non-CPU platform")
+
+
+# Pin the config eagerly at collection time — before any test (or import
+# side effect) can initialize a backend and freeze the platform choice —
+# but skip device verification (creating the XLA CPU client costs seconds)
+# so non-JAX test subsets don't pay for it; require_jax() verifies lazily.
+pin_cpu_inprocess(8, verify=False)
 
 
 @pytest.fixture

@@ -24,13 +24,8 @@ def cpu_devices():
 
 
 class TestWorkload:
-    def test_flagship_compiles_and_runs(self):
-        from tpu_pod_exporter.loadgen.workload import flagship
-
-        fn, (params, x) = flagship(width=64, depth=2, batch=8)
-        out = np.asarray(fn(params, x))
-        assert out.shape == (8, 64)
-        assert np.isfinite(out.astype(np.float32)).all()
+    # flagship compile+run is covered by selftest.CHECKS["flagship"]
+    # via tests/test_parallel.py (single source with the driver gate).
 
     def test_forward_is_deterministic(self):
         from tpu_pod_exporter.loadgen.workload import flagship
@@ -75,17 +70,8 @@ class TestSharded:
         with pytest.raises(ValueError):
             make_mesh(8, dp=3, tp=2)
 
-    def test_sharded_train_step_runs_and_learns(self, cpu_devices):
-        from tpu_pod_exporter.loadgen.sharded import make_mesh, sharded_train_step
-
-        mesh = make_mesh(8)
-        step, params, (x, y) = sharded_train_step(mesh, width=64, depth=2, batch=16)
-        losses = []
-        for _ in range(5):
-            params, loss = step(params, x, y)
-            losses.append(float(loss))
-        assert all(np.isfinite(losses))
-        assert losses[-1] < losses[0]  # SGD on a fixed batch must descend
+    # sharded-step descent is covered by selftest.CHECKS["sharded_descends"]
+    # via tests/test_parallel.py (single source with the driver gate).
 
     def test_param_and_batch_shardings_applied(self, cpu_devices):
         from tpu_pod_exporter.loadgen.sharded import make_mesh, sharded_train_step
@@ -112,7 +98,6 @@ class TestGraftEntry:
         out = fn(*args)
         assert np.asarray(out).shape == (32, 128)
 
-    def test_dryrun_multichip_8(self, cpu_devices):
-        import __graft_entry__ as ge
-
-        ge.dryrun_multichip(8)
+    # dryrun_multichip is covered by tests/test_selftest.py — it now runs
+    # in a sanitized child process (see tpu_pod_exporter.jaxenv), so the
+    # in-process cpu_devices fixture is no longer the right harness.

@@ -1,109 +1,39 @@
 """SP/PP/EP sharded programs vs single-device ground truth (virtual 8-device
 CPU mesh from conftest; SURVEY.md §2.8 — the distributed dimension as
-instrument)."""
+instrument).
 
-import numpy as np
+The numeric bodies live in ``tpu_pod_exporter.loadgen.selftest.CHECKS`` —
+the same functions the driver's sanitized-subprocess gate runs — so the
+pytest suite and the driver gate can never drift apart.
+"""
+
 import pytest
 
 from tests.conftest import require_jax
+from tpu_pod_exporter.loadgen import selftest
 
 N = 8
 
 
-@pytest.fixture(scope="module", autouse=True)
-def _need_jax():
+@pytest.mark.parametrize("name", sorted(selftest.CHECKS))
+def test_check(name):
     require_jax()
+    result = selftest.CHECKS[name](N)
+    assert result.get("ok"), f"{name}: {result}"
 
 
-def test_ring_attention_matches_full_attention():
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_pod_exporter.loadgen.parallel import (
-        make_1d_mesh, reference_attention, ring_attention_fn,
-    )
-
-    mesh = make_1d_mesh(N, "seq")
-    fn, sharding = ring_attention_fn(mesh)
-    t, d = 4 * N, 16
-    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
-    q = jax.random.normal(k1, (t, d), jnp.float32)
-    k = jax.random.normal(k2, (t, d), jnp.float32)
-    v = jax.random.normal(k3, (t, d), jnp.float32)
-    out = fn(
-        jax.device_put(q, sharding),
-        jax.device_put(k, sharding),
-        jax.device_put(v, sharding),
-    )
-    ref = reference_attention(q, k, v)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-5, atol=2e-5)
+def test_dryrun_checks_subset():
+    assert set(selftest.DRYRUN_CHECKS) <= set(selftest.CHECKS)
 
 
-def test_ring_attention_uneven_values_stay_stable():
-    # Large score magnitudes exercise the running-max renormalization.
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_pod_exporter.loadgen.parallel import (
-        make_1d_mesh, reference_attention, ring_attention_fn,
-    )
-
-    mesh = make_1d_mesh(N, "seq")
-    fn, sharding = ring_attention_fn(mesh)
-    t, d = 2 * N, 4
-    q = 30.0 * jax.random.normal(jax.random.PRNGKey(0), (t, d), jnp.float32)
-    k = 30.0 * jax.random.normal(jax.random.PRNGKey(1), (t, d), jnp.float32)
-    v = jax.random.normal(jax.random.PRNGKey(2), (t, d), jnp.float32)
-    out = np.asarray(fn(*(jax.device_put(a, sharding) for a in (q, k, v))))
-    assert np.isfinite(out).all()
-    np.testing.assert_allclose(
-        out, np.asarray(reference_attention(q, k, v)), rtol=1e-4, atol=1e-4
-    )
-
-
-def test_pipeline_matches_sequential_stages():
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_pod_exporter.loadgen.parallel import (
-        make_1d_mesh, pipeline_forward_fn, reference_pipeline,
-    )
-
-    mesh = make_1d_mesh(N, "stage")
-    n_micro, mb, width = 2 * N, 4, 8
-    fn, w_sharding = pipeline_forward_fn(mesh)
-    stage_w = 0.5 * jax.random.normal(
-        jax.random.PRNGKey(3), (N, width, width), jnp.float32
-    )
-    xs = jax.random.normal(jax.random.PRNGKey(4), (n_micro, mb, width), jnp.float32)
-    out = fn(jax.device_put(stage_w, w_sharding), xs)
-    ref = reference_pipeline(stage_w, xs)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
-
-
-def test_moe_matches_position_routed_reference():
-    import jax
-    import jax.numpy as jnp
-
-    from tpu_pod_exporter.loadgen.parallel import (
-        make_1d_mesh, moe_forward_fn, reference_moe,
-    )
-
-    mesh = make_1d_mesh(N, "expert")
-    fn, w_sharding, x_sharding = moe_forward_fn(mesh)
-    d = 8
-    tokens = N * N * 2  # local count divisible by expert count
-    expert_w = 0.5 * jax.random.normal(jax.random.PRNGKey(5), (N, d, d), jnp.float32)
-    x = jax.random.normal(jax.random.PRNGKey(6), (tokens, d), jnp.float32)
-    out = fn(jax.device_put(expert_w, w_sharding), jax.device_put(x, x_sharding))
-    ref = reference_moe(expert_w, x)
-    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), rtol=2e-4, atol=2e-4)
-
-
-def test_parallelism_dryrun_finite():
-    from tpu_pod_exporter.loadgen.parallel import run_parallelism_dryrun
-
-    results = run_parallelism_dryrun(4)
-    assert set(results) == {"ring_attention", "pipeline", "moe"}
-    for name, val in results.items():
-        assert val == val, f"{name} produced NaN"
+def test_run_checks_reports_failures():
+    """A raising check must surface as ok=False with the error, not crash."""
+    saved = dict(selftest.CHECKS)
+    try:
+        selftest.CHECKS["boom"] = lambda n: (_ for _ in ()).throw(ValueError("x"))
+        results = selftest.run_checks(2, ["boom"])
+    finally:
+        selftest.CHECKS.clear()
+        selftest.CHECKS.update(saved)
+    assert results["boom"]["ok"] is False
+    assert "ValueError" in results["boom"]["error"]

@@ -1,0 +1,48 @@
+"""The sanitized-subprocess selftest path — exactly what the driver's
+``dryrun_multichip`` gate runs (see ``tpu_pod_exporter.jaxenv`` for why a
+child process is required on this machine)."""
+
+import importlib.util
+import sys
+from pathlib import Path
+
+import pytest
+
+from tpu_pod_exporter.jaxenv import HAZARD_ENV_VARS, cpu_subprocess_env
+from tpu_pod_exporter.loadgen.selftest import run_subprocess
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def test_cpu_subprocess_env_sanitizes():
+    base = {
+        "PALLAS_AXON_POOL_IPS": "127.0.0.1",
+        "JAX_PLATFORMS": "axon",
+        "XLA_FLAGS": "--xla_foo --xla_force_host_platform_device_count=2",
+        "PATH": "/usr/bin",
+    }
+    env = cpu_subprocess_env(4, base=base)
+    for var in HAZARD_ENV_VARS:
+        assert var not in env
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert "--xla_force_host_platform_device_count=4" in env["XLA_FLAGS"]
+    assert "device_count=2" not in env["XLA_FLAGS"]
+    assert "--xla_foo" in env["XLA_FLAGS"]  # unrelated flags preserved
+    assert env["PATH"] == "/usr/bin"
+
+
+def test_dryrun_multichip_entrypoint():
+    """The driver's gate end-to-end: __graft_entry__.dryrun_multichip spawns
+    the sanitized selftest child and asserts its report."""
+    if importlib.util.find_spec("jax") is None:
+        pytest.skip("jax not installed")
+    sys.path.insert(0, str(REPO))
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(4)
+
+
+def test_selftest_rejects_unknown_check():
+    proc = run_subprocess(2, checks="nope", timeout=60)
+    assert proc.returncode == 2
+    assert "unknown checks" in proc.stdout

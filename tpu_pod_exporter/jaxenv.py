@@ -1,0 +1,129 @@
+"""JAX environment hardening for the CPU-mesh code paths.
+
+On this machine a ``sitecustomize.py`` (triggered by the
+``PALLAS_AXON_POOL_IPS`` env var) registers an experimental TPU-tunnel PJRT
+plugin in *every* Python interpreter and force-updates
+``jax.config.jax_platforms`` to ``"axon,cpu"`` — overriding any
+``JAX_PLATFORMS`` env var the caller set. Because ``jax.devices("cpu")``
+initializes *all* configured platforms before filtering, even a
+CPU-only query then dials the tunnel and can hang the process forever
+(round 1's ``MULTICHIP`` rc=124).
+
+Two escapes, both verified on this image:
+
+1. **In-process pin** (:func:`pin_cpu_inprocess`): the plugin registration
+   does not eagerly initialize backends, so re-updating
+   ``jax_platforms="cpu"`` *before the first backend init* restores a pure
+   CPU world. ``XLA_FLAGS`` is also still effective at that point (XLA
+   reads it at client creation, not at import).
+2. **Sanitized subprocess** (:func:`cpu_subprocess_env`): drop the
+   sitecustomize trigger var entirely so the child never registers the
+   plugin, and pin ``JAX_PLATFORMS=cpu`` + the virtual device count.
+
+The in-process pin is used by the test suite (fast, granular); the
+subprocess is used by ``__graft_entry__.dryrun_multichip`` where the
+calling process may already have initialized (or wedged) backends.
+
+Reference contrast: the reference exporter's only device runtime is NVML,
+initialized once and fatally (``main.go:44-54``); here the accelerator
+runtime is actively hostile to naive init and must be fenced.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+# Env vars that make sitecustomize register the TPU-tunnel PJRT plugin.
+HAZARD_ENV_VARS = ("PALLAS_AXON_POOL_IPS",)
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def _with_device_count(flags: str, n_devices: int) -> str:
+    """XLA_FLAGS string with the host-device-count flag forced to n."""
+    kept = [f for f in flags.split() if not f.startswith(_COUNT_FLAG)]
+    kept.append(f"{_COUNT_FLAG}={n_devices}")
+    return " ".join(kept)
+
+
+def cpu_subprocess_env(n_devices: int, base: dict | None = None) -> dict:
+    """Environment for a child process that must see an n-device CPU mesh
+    and must never initialize the TPU-tunnel plugin."""
+    env = dict(os.environ if base is None else base)
+    for var in HAZARD_ENV_VARS:
+        env.pop(var, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = _with_device_count(env.get("XLA_FLAGS", ""), n_devices)
+    return env
+
+
+def _backends_initialized() -> bool:
+    """Whether JAX backends are already initialized. Uses a private API
+    (``jax._src.xla_bridge``) with a graceful fallback: if a jax upgrade
+    moves it, treat the state as not-initialized — the config update then
+    either takes effect (fine) or is a no-op against live caches, which
+    the device verification below catches."""
+    try:
+        from jax._src import xla_bridge
+
+        return bool(xla_bridge.backends_are_initialized())
+    except Exception:
+        return False
+
+
+def pin_cpu_inprocess(n_devices: int | None = None, verify: bool = True) -> bool:
+    """Pin this process's JAX to the CPU platform; return True on success.
+
+    Must run before the first backend initialization. If backends are
+    already initialized, succeeds only when the default platform is
+    already CPU. Never raises; never dials the tunnel plugin. On failure
+    the env mutations are rolled back so later-spawned children don't
+    inherit a pin that never took effect.
+
+    ``verify=False`` skips the ``jax.devices()`` check — it pins the
+    config without creating the XLA CPU client (seconds of startup),
+    for eager use at import time; call again with ``verify=True``
+    before trusting the mesh size.
+    """
+    saved = {
+        k: os.environ.get(k) for k in ("XLA_FLAGS", "JAX_PLATFORMS")
+    }
+
+    def _rollback() -> bool:
+        for key, val in saved.items():
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
+        return False
+
+    if n_devices:
+        os.environ["XLA_FLAGS"] = _with_device_count(
+            os.environ.get("XLA_FLAGS", ""), n_devices
+        )
+    os.environ["JAX_PLATFORMS"] = "cpu"  # children + late config reads
+    if not verify and "jax" not in sys.modules:
+        # jax was never imported in this process (no sitecustomize hook):
+        # the env vars alone govern the eventual import, so skip paying
+        # the multi-second jax import at pin time.
+        return True
+    try:
+        import jax
+    except Exception:
+        return _rollback()
+    try:
+        if not _backends_initialized():
+            jax.config.update("jax_platforms", "cpu")
+        elif jax.default_backend() != "cpu":
+            return _rollback()
+        if not verify:
+            return True
+        devs = jax.devices()
+    except Exception:
+        return _rollback()
+    if devs and devs[0].platform != "cpu":
+        return _rollback()
+    if n_devices and len(devs) < n_devices:
+        return _rollback()
+    return True

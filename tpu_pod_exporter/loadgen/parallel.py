@@ -33,13 +33,15 @@ def _shard_map():
     return sm
 
 
-def make_1d_mesh(n_devices: int, axis: str):
+def make_1d_mesh(n_devices: int, axis: str, platform: str | None = None):
     import numpy as np
     from jax.sharding import Mesh
 
     from tpu_pod_exporter.loadgen.sharded import pick_devices
 
-    return Mesh(np.array(pick_devices(n_devices)), axis_names=(axis,))
+    return Mesh(
+        np.array(pick_devices(n_devices, platform=platform)), axis_names=(axis,)
+    )
 
 
 # --------------------------------------------------------------------- ring

@@ -1,0 +1,278 @@
+"""Self-contained numeric verification of every loadgen parallelism program.
+
+Runs the SP/PP/EP programs and the dp×tp sharded train step on an n-device
+CPU mesh and compares each against its single-device ground truth, printing
+ONE JSON line with per-check results. Designed to run inside a *sanitized*
+child process (see ``tpu_pod_exporter.jaxenv``) so it works even when the
+parent's JAX runtime is wedged by the experimental TPU-tunnel plugin:
+
+    python -m tpu_pod_exporter.loadgen.selftest --n 8 --checks all
+
+``__graft_entry__.dryrun_multichip`` runs ``--checks dryrun`` (compile +
+execute only, the driver's gate); the test suite asserts on ``--checks
+all`` numerics. Exit code 0 iff every requested check passed.
+
+This is the seam the reference lacks entirely (zero tests — SURVEY.md §4);
+the numeric-parity strategy follows §2.8: each parallelism strategy is
+verified against a dense single-device reference before it is trusted as
+an ICI-traffic instrument.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import traceback
+from pathlib import Path
+
+
+def run_subprocess(
+    n_devices: int,
+    checks: str = "dryrun",
+    timeout: float = 300,
+) -> subprocess.CompletedProcess:
+    """Spawn this module as a sanitized child (see ``jaxenv``) and return
+    the completed process. The single source of the spawn recipe — used by
+    ``__graft_entry__.dryrun_multichip`` and the tests, so the env contract
+    can't drift between the driver gate and the suite. Raises
+    ``subprocess.TimeoutExpired`` (with captured output) on hang."""
+    from tpu_pod_exporter.jaxenv import cpu_subprocess_env
+
+    repo = Path(__file__).resolve().parents[2]
+    env = cpu_subprocess_env(n_devices)
+    env["PYTHONPATH"] = str(repo) + os.pathsep + env.get("PYTHONPATH", "")
+    cmd = [
+        sys.executable,
+        "-m",
+        "tpu_pod_exporter.loadgen.selftest",
+        "--n",
+        str(n_devices),
+        "--checks",
+        checks,
+    ]
+    return subprocess.run(
+        cmd, cwd=repo, env=env, capture_output=True, text=True, timeout=timeout
+    )
+
+
+def _close(out, ref, rtol: float, atol: float) -> dict:
+    """allclose verdict + max abs error, matching assert_allclose semantics
+    (per-element bound atol + rtol*|ref|, not a flat absolute cutoff)."""
+    import numpy as np
+
+    out = np.asarray(out)
+    ref = np.asarray(ref)
+    return {
+        "ok": bool(np.allclose(out, ref, rtol=rtol, atol=atol)),
+        "max_abs_err": float(np.max(np.abs(out - ref))),
+    }
+
+
+def _pin_or_die(n: int) -> None:
+    from tpu_pod_exporter.jaxenv import pin_cpu_inprocess
+
+    if not pin_cpu_inprocess(n):
+        print(
+            json.dumps(
+                {
+                    "fatal": f"could not pin a {n}-device CPU mesh "
+                    "(backends already initialized on a non-CPU platform?)"
+                }
+            )
+        )
+        raise SystemExit(3)
+
+
+# --------------------------------------------------------------- checks
+
+def check_dryrun_dp_tp(n: int) -> dict:
+    from tpu_pod_exporter.loadgen.sharded import run_dryrun
+
+    loss = run_dryrun(n, steps=1)
+    return {"ok": loss == loss, "loss": loss}
+
+
+def check_dryrun_parallelism(n: int) -> dict:
+    from tpu_pod_exporter.loadgen.parallel import run_parallelism_dryrun
+
+    results = run_parallelism_dryrun(n)
+    ok = all(v == v for v in results.values())
+    return {"ok": ok, **results}
+
+
+def check_ring_attention(n: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_1d_mesh,
+        reference_attention,
+        ring_attention_fn,
+    )
+
+    mesh = make_1d_mesh(n, "seq")
+    fn, sharding = ring_attention_fn(mesh)
+    t, d = 4 * n, 16
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(7), 3)
+    q = jax.random.normal(k1, (t, d), jnp.float32)
+    k = jax.random.normal(k2, (t, d), jnp.float32)
+    v = jax.random.normal(k3, (t, d), jnp.float32)
+    out = fn(*(jax.device_put(a, sharding) for a in (q, k, v)))
+    return _close(out, reference_attention(q, k, v), rtol=2e-5, atol=2e-5)
+
+
+def check_ring_attention_stability(n: int) -> dict:
+    """Large score magnitudes exercise the running-max renormalization."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_1d_mesh,
+        reference_attention,
+        ring_attention_fn,
+    )
+
+    mesh = make_1d_mesh(n, "seq")
+    fn, sharding = ring_attention_fn(mesh)
+    t, d = 2 * n, 4
+    q = 30.0 * jax.random.normal(jax.random.PRNGKey(0), (t, d), jnp.float32)
+    k = 30.0 * jax.random.normal(jax.random.PRNGKey(1), (t, d), jnp.float32)
+    v = jax.random.normal(jax.random.PRNGKey(2), (t, d), jnp.float32)
+    out = np.asarray(fn(*(jax.device_put(a, sharding) for a in (q, k, v))))
+    finite = bool(np.isfinite(out).all())
+    res = _close(out, reference_attention(q, k, v), rtol=1e-4, atol=1e-4)
+    return {**res, "ok": finite and res["ok"], "finite": finite}
+
+
+def check_pipeline(n: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_1d_mesh,
+        pipeline_forward_fn,
+        reference_pipeline,
+    )
+
+    mesh = make_1d_mesh(n, "stage")
+    n_micro, mb, width = 2 * n, 4, 8
+    fn, w_sharding = pipeline_forward_fn(mesh)
+    stage_w = 0.5 * jax.random.normal(
+        jax.random.PRNGKey(3), (n, width, width), jnp.float32
+    )
+    xs = jax.random.normal(jax.random.PRNGKey(4), (n_micro, mb, width), jnp.float32)
+    out = fn(jax.device_put(stage_w, w_sharding), xs)
+    return _close(out, reference_pipeline(stage_w, xs), rtol=2e-4, atol=2e-4)
+
+
+def check_moe(n: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_1d_mesh,
+        moe_forward_fn,
+        reference_moe,
+    )
+
+    mesh = make_1d_mesh(n, "expert")
+    fn, w_sharding, x_sharding = moe_forward_fn(mesh)
+    d = 8
+    tokens = n * n * 2
+    expert_w = 0.5 * jax.random.normal(jax.random.PRNGKey(5), (n, d, d), jnp.float32)
+    x = jax.random.normal(jax.random.PRNGKey(6), (tokens, d), jnp.float32)
+    out = fn(jax.device_put(expert_w, w_sharding), jax.device_put(x, x_sharding))
+    return _close(out, reference_moe(expert_w, x), rtol=2e-4, atol=2e-4)
+
+
+def check_sharded_descends(n: int) -> dict:
+    """SGD on a fixed batch must strictly descend over 5 steps."""
+    import numpy as np
+
+    from tpu_pod_exporter.loadgen.sharded import make_mesh, sharded_train_step
+
+    mesh = make_mesh(n)
+    step, params, (x, y) = sharded_train_step(mesh, width=64, depth=2, batch=16)
+    losses = []
+    for _ in range(5):
+        params, loss = step(params, x, y)
+        losses.append(float(loss))
+    ok = bool(np.isfinite(losses).all()) and losses[-1] < losses[0]
+    return {"ok": ok, "losses": losses}
+
+
+def check_flagship(n: int) -> dict:
+    import numpy as np
+
+    from tpu_pod_exporter.loadgen.workload import flagship
+
+    fn, (params, x) = flagship(width=64, depth=2, batch=8)
+    out = np.asarray(fn(params, x)).astype(np.float32)
+    ok = out.shape == (8, 64) and bool(np.isfinite(out).all())
+    return {"ok": ok, "shape": list(out.shape)}
+
+
+CHECKS = {
+    "dryrun_dp_tp": check_dryrun_dp_tp,
+    "dryrun_parallelism": check_dryrun_parallelism,
+    "ring_attention": check_ring_attention,
+    "ring_attention_stability": check_ring_attention_stability,
+    "pipeline": check_pipeline,
+    "moe": check_moe,
+    "sharded_descends": check_sharded_descends,
+    "flagship": check_flagship,
+}
+
+# The driver's multichip gate: compile + execute every strategy, no
+# reference numerics (they add single-device compiles and wall time).
+DRYRUN_CHECKS = ("dryrun_dp_tp", "dryrun_parallelism")
+
+
+def run_checks(n: int, names) -> dict:
+    results: dict[str, dict] = {}
+    for name in names:
+        try:
+            results[name] = CHECKS[name](n)
+        except Exception as exc:  # noqa: BLE001 — reported, not swallowed
+            results[name] = {
+                "ok": False,
+                "error": f"{type(exc).__name__}: {exc}",
+                "traceback": traceback.format_exc(limit=5),
+            }
+    return results
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--n", type=int, default=8, help="mesh size")
+    parser.add_argument(
+        "--checks",
+        default="all",
+        help="'all', 'dryrun', or comma-separated check names",
+    )
+    args = parser.parse_args(argv)
+
+    if args.checks == "all":
+        names = list(CHECKS)
+    elif args.checks == "dryrun":
+        names = list(DRYRUN_CHECKS)
+    else:
+        names = [c.strip() for c in args.checks.split(",") if c.strip()]
+        unknown = [c for c in names if c not in CHECKS]
+        if unknown:
+            print(json.dumps({"fatal": f"unknown checks: {unknown}"}))
+            return 2
+
+    _pin_or_die(args.n)
+    results = run_checks(args.n, names)
+    ok = all(r.get("ok") for r in results.values())
+    print(json.dumps({"n_devices": args.n, "ok": ok, "checks": results}))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

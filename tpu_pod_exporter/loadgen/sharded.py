@@ -17,29 +17,44 @@ from __future__ import annotations
 from tpu_pod_exporter.loadgen.workload import init_params, loss_fn
 
 
-def pick_devices(n: int):
-    """n devices, preferring the virtual CPU mesh when it satisfies n (the
-    test/dry-run path) and falling back to the default platform (real TPUs)."""
+def pick_devices(n: int, platform: str | None = None):
+    """n devices. With ``platform`` given, only that platform is consulted.
+
+    Otherwise the choice keys off the *configured* platform list
+    (``jax.config.jax_platforms``), not device counts: when the process is
+    pinned to CPU (the sanitized dry-run/test path — see
+    ``tpu_pod_exporter.jaxenv``), use the virtual CPU mesh; in every other
+    configuration use the default platform, so a leaked
+    ``xla_force_host_platform_device_count`` can never silently steal a
+    real-TPU run onto CPU devices.
+    """
     import jax
 
-    try:
+    if platform is not None:
+        devs = jax.devices(platform)
+        if len(devs) >= n:
+            return devs[:n]
+        raise ValueError(f"need {n} {platform} devices, have {len(devs)}")
+    configured = (jax.config.jax_platforms or "").split(",")
+    if configured[0] == "cpu":
         cpus = jax.devices("cpu")
-    except RuntimeError:
-        cpus = []
-    if len(cpus) >= n and len(jax.devices()) < n:
-        return cpus[:n]
+        if len(cpus) >= n:
+            return cpus[:n]
     devs = jax.devices()
     if len(devs) >= n:
         return devs[:n]
     raise ValueError(
-        f"need {n} devices, have {len(devs)} ({len(cpus)} cpu); "
+        f"need {n} devices, have {len(devs)} on "
+        f"{devs[0].platform if devs else 'no'} platform; "
         "set XLA_FLAGS=--xla_force_host_platform_device_count"
     )
 
 
-def make_mesh(n_devices: int, dp: int | None = None, tp: int | None = None):
+def make_mesh(n_devices: int, dp: int | None = None, tp: int | None = None,
+              platform: str | None = None):
     """A (data, model) mesh over n devices. dp×tp must equal n; defaults to
-    the most-square factorization with dp ≥ tp."""
+    the most-square factorization with dp ≥ tp. ``platform`` pins device
+    selection (e.g. ``"tpu"`` on a real slice)."""
     import numpy as np
     from jax.sharding import Mesh
 
@@ -52,7 +67,7 @@ def make_mesh(n_devices: int, dp: int | None = None, tp: int | None = None):
         dp = n_devices // tp
     if dp * tp != n_devices:
         raise ValueError(f"dp({dp}) * tp({tp}) != n_devices({n_devices})")
-    devices = np.array(pick_devices(n_devices)).reshape(dp, tp)
+    devices = np.array(pick_devices(n_devices, platform=platform)).reshape(dp, tp)
     return Mesh(devices, axis_names=("data", "model"))
 
 
