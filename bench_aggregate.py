@@ -1,0 +1,70 @@
+"""Aggregator round-duration benchmark at v5p-128-scale inputs.
+
+64 targets × 256-chip exposition bodies (~16k chip series + per-pod/link
+series) folded by ``SliceAggregator.poll_once`` with an injected fetch, so
+the number is pure parse+fold cost — no network. Prints one JSON line;
+the result is recorded in BASELINE.md (VERDICT r1 #8).
+
+Run: ``python bench_aggregate.py [--hosts 64] [--chips 256] [--rounds 5]``
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from pathlib import Path
+
+sys.path.insert(0, str(Path(__file__).resolve().parent))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--hosts", type=int, default=64)
+    p.add_argument("--chips", type=int, default=256)
+    p.add_argument("--rounds", type=int, default=5)
+    args = p.parse_args(argv)
+
+    from tests.test_aggregate import StaticFetch, make_host_text
+
+    from tpu_pod_exporter.aggregate import SliceAggregator
+    from tpu_pod_exporter.metrics import SnapshotStore
+
+    body = make_host_text(0, chips=args.chips)
+    pages = {
+        f"h{w}:8000": body.replace('host="host-0"', f'host="host-{w}"')
+        for w in range(args.hosts)
+    }
+    total_series = sum(page.count("\n") for page in pages.values())
+
+    store = SnapshotStore()
+    agg = SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages))
+    agg.poll_once()  # warm (allocators, interned labels)
+    times = []
+    for _ in range(args.rounds):
+        t0 = time.perf_counter()
+        agg.poll_once()
+        times.append(time.perf_counter() - t0)
+
+    snap = store.current()
+    key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+    assert snap.value("tpu_slice_chip_count", key) == float(args.hosts * args.chips)
+    med = statistics.median(times)
+    print(json.dumps({
+        "metric": f"aggregator_round_ms_{args.hosts}x{args.chips}",
+        "value": round(med * 1000, 1),
+        "unit": "ms",
+        "hosts": args.hosts,
+        "chips_per_host": args.chips,
+        "approx_input_lines": total_series,
+        "rounds": args.rounds,
+        "min_ms": round(min(times) * 1000, 1),
+        "max_ms": round(max(times) * 1000, 1),
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -183,6 +183,23 @@ class TestSliceAggregator:
             {"slice_name": "slice-a", "accelerator": "v5p-64"},
         ) == 4.0
 
+    def test_missing_host_label_not_counted_as_a_host(self):
+        # An exporter that omits the host label must not collapse into a
+        # phantom host "" in hosts_reporting; its chips still count.
+        nohost = (
+            'tpu_hbm_used_bytes{chip_id="0",slice_name="slice-a",'
+            'accelerator="v5p-64"} 1\n'
+        )
+        pages = {"h0:8000": make_host_text(0), "bare:8000": nohost}
+        store = SnapshotStore()
+        SliceAggregator(
+            tuple(pages), store, fetch=StaticFetch(pages)
+        ).poll_once()
+        snap = store.current()
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
+        assert snap.value("tpu_slice_chip_count", key) == 5.0
+
     def test_unallocated_chips_do_not_create_workloads(self):
         store = SnapshotStore()
         Collector(FakeBackend(chips=2), FakeAttribution(), store).poll_once()

@@ -96,6 +96,16 @@ class TestFullScan:
         holders = ProcScanner(proc_root=str(tmp_path)).scan()
         assert holders == (DeviceHolder(60, "train_worker", "/dev/vfio/17"),)
 
+    def test_vfio_container_node_excluded(self, tmp_path):
+        # /dev/vfio/vfio is the shared container node every vfio-using
+        # process opens (including non-TPU passthrough users) — it must
+        # not become a holder, while /dev/vfio/<group> still does.
+        add_proc(tmp_path, 61, ["/dev/vfio/vfio"], cgroup=CGROUP_NON_POD)
+        add_proc(tmp_path, 62, ["/dev/vfio/vfio", "/dev/vfio/9"],
+                 cgroup=CGROUP_NON_POD)
+        holders = ProcScanner(proc_root=str(tmp_path)).scan()
+        assert [(h.pid, h.device_path) for h in holders] == [(62, "/dev/vfio/9")]
+
     def test_unreadable_fd_table_skips_process(self, tmp_path):
         d = tmp_path / "300"
         d.mkdir()

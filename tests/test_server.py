@@ -173,6 +173,32 @@ class TestOpenMetrics:
         assert b"# EOF" not in body
         assert b"# TYPE c_total counter" in body
 
+    def test_q_zero_refuses_openmetrics(self, served_store):
+        # Explicit q=0 on the OpenMetrics token means "never send me this".
+        store, base = served_store
+        self._counter_snapshot(store)
+        status, headers, body = get(
+            base + "/metrics",
+            headers={"Accept": "application/openmetrics-text;q=0, text/plain"},
+        )
+        assert headers["Content-Type"].startswith("text/plain")
+        assert b"# EOF" not in body
+
+    def test_counter_header_rewrite_is_line_anchored(self, served_store):
+        # A HELP text *containing* "# HELP c_total " mid-line must not be
+        # rewritten in place of the real header line.
+        from tpu_pod_exporter.metrics.registry import COUNTER
+
+        store, base = served_store
+        b = SnapshotBuilder()
+        b.add(MetricSpec(name="a", help="docs mention # HELP c_total here"), 1.0)
+        b.add(MetricSpec(name="c_total", help="a counter", type=COUNTER), 3.0)
+        store.swap(b.build())
+        status, headers, body = get(base + "/metrics", headers=self.OM_ACCEPT)
+        assert b"# HELP a docs mention # HELP c_total here\n" in body
+        assert b"\n# HELP c a counter\n" in body
+        assert b"# TYPE c counter" in body
+
     def test_openmetrics_gzip(self, served_store):
         store, base = served_store
         self._counter_snapshot(store)
@@ -209,3 +235,34 @@ class TestOpenMetrics:
         assert "tpu_hbm_used_bytes" in fams
         samples = fams["tpu_ici_transferred_bytes"].samples
         assert all(s.name == "tpu_ici_transferred_bytes_total" for s in samples)
+
+
+class TestAcceptParsing:
+    """accepts_openmetrics q-value semantics (RFC 9110 §12.4.2 subset)."""
+
+    def test_cases(self):
+        from tpu_pod_exporter.server import accepts_openmetrics as acc
+
+        assert acc("application/openmetrics-text") is True
+        assert acc("application/openmetrics-text;version=1.0.0;q=0.9") is True
+        assert acc("application/openmetrics-text;q=0, text/plain") is False
+        assert acc("application/openmetrics-text;q=0.0") is False
+        assert acc("application/openmetrics-text; q=0 ") is False
+        # client prefers text (om down-weighted below text/plain's q=1)
+        assert acc("text/plain, application/openmetrics-text ;q=0.001") is False
+        # om down-weighted but still above text/plain
+        assert acc("text/plain;q=0.5, application/openmetrics-text;q=0.9") is True
+        # the Prometheus >=2.5 header shape
+        assert acc(
+            "application/openmetrics-text;version=1.0.0;q=0.75,"
+            "text/plain;version=0.0.4;q=0.5"
+        ) is True
+        # equal preference goes to the richer format
+        assert acc("text/plain;q=0.5, application/openmetrics-text;q=0.5") is True
+        # wildcard sets text/plain's implicit q
+        assert acc("*/*;q=1, application/openmetrics-text;q=0.2") is False
+        assert acc("text/plain") is False
+        assert acc("") is False
+        assert acc("APPLICATION/OpenMetrics-Text") is True
+        # malformed q counts as accepting (q defaults to 1)
+        assert acc("application/openmetrics-text;q=abc") is True

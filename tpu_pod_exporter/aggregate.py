@@ -197,7 +197,13 @@ class SliceAggregator:
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.chips += 1
                 agg.hbm_used += s.value
-                agg.hosts.add(s.labels.get("host", ""))
+                # A missing host label must not count as host "" — mixed
+                # with exporters that omit the label, all such hosts would
+                # collapse into one and undercount hosts_reporting. The
+                # sample still contributes to chip/HBM sums above.
+                host = s.labels.get("host")
+                if host:
+                    agg.hosts.add(host)
             elif name == "tpu_hbm_total_bytes":
                 SliceAggregator._slice(slices, s.labels).hbm_total += s.value
             elif name == "tpu_tensorcore_duty_cycle_percent":
@@ -216,7 +222,9 @@ class SliceAggregator:
                     w = workloads[key] = _WorkloadAgg()
                 if name == "tpu_pod_chip_count":
                     w.chips += s.value
-                    w.hosts.add(s.labels.get("host", ""))
+                    host = s.labels.get("host")
+                    if host:  # same missing-label rule as hosts_reporting
+                        w.hosts.add(host)
                 else:
                     w.hbm_used += s.value
 

@@ -106,8 +106,43 @@ def _build_named_attribution(choice: str, cfg: ExporterConfig) -> AttributionPro
     if choice == "checkpoint":
         from tpu_pod_exporter.attribution.checkpoint import CheckpointAttribution
 
-        return CheckpointAttribution(path=cfg.checkpoint_path)
+        return CheckpointAttribution(
+            path=cfg.checkpoint_path, uid_source=_build_uid_source(cfg)
+        )
     raise ValueError(f"unknown attribution: {choice}")
+
+
+def _build_uid_source(cfg: ExporterConfig):
+    """UID→name resolver for the checkpoint path (None = uid-keyed series).
+    A static file wins over the kubelet /pods endpoint when both are set."""
+    if cfg.uid_map_file:
+        from tpu_pod_exporter.attribution.uidmap import StaticUidMap
+
+        return StaticUidMap(cfg.uid_map_file)
+    if cfg.kubelet_pods_url:
+        from tpu_pod_exporter.attribution.uidmap import (
+            DEFAULT_CA_FILE,
+            DEFAULT_TOKEN_FILE,
+            KubeletPodsUidMap,
+        )
+
+        token_file = cfg.kubelet_token_file
+        ca_file = cfg.kubelet_ca_file
+        if cfg.kubelet_pods_url.startswith("https:"):
+            # Auto-default BOTH in-pod SA mounts together: defaulting the
+            # bearer token without the CA bundle would send a real cluster
+            # credential over unverified TLS.
+            if not token_file and os.path.exists(DEFAULT_TOKEN_FILE):
+                token_file = DEFAULT_TOKEN_FILE
+            if not ca_file and os.path.exists(DEFAULT_CA_FILE):
+                ca_file = DEFAULT_CA_FILE
+        return KubeletPodsUidMap(
+            cfg.kubelet_pods_url,
+            token_file=token_file or None,
+            ca_file=ca_file or None,
+            refresh_s=cfg.kubelet_pods_refresh_s,
+        )
+    return None
 
 
 class ExporterApp:

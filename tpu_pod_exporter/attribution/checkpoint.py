@@ -90,9 +90,26 @@ class CheckpointAttribution(AttributionProvider):
         self,
         path: str = DEFAULT_CHECKPOINT,
         uid_to_pod: Mapping[str, tuple[str, str]] | None = None,
+        uid_source=None,
     ) -> None:
+        """``uid_to_pod`` is a fixed mapping; ``uid_source`` is a live
+        resolver with a ``mapping()`` method (``uidmap.StaticUidMap`` /
+        ``uidmap.KubeletPodsUidMap``) re-consulted every snapshot so pod
+        churn is picked up. If both are given the source wins."""
         self._path = path
         self._uid_to_pod = uid_to_pod
+        self._uid_source = uid_source
+        self._uid_map_errors = 0
+
+    def error_counters(self) -> dict[str, float]:
+        """Cumulative side-channel error counts, published by the collector
+        as ``tpu_exporter_poll_errors_total{source="uid_map"}`` — covers
+        both resolver exceptions seen here and the kubelet source's
+        internal fetch failures (which degrade to last-good silently)."""
+        total = self._uid_map_errors + int(
+            getattr(self._uid_source, "fetch_errors", 0) or 0
+        )
+        return {"uid_map": float(total)} if total else {}
 
     def snapshot(self) -> AttributionSnapshot:
         try:
@@ -100,4 +117,14 @@ class CheckpointAttribution(AttributionProvider):
                 raw = f.read()
         except OSError as e:
             raise AttributionError(f"cannot read checkpoint {self._path}: {e}") from e
-        return parse_checkpoint(raw, self._uid_to_pod)
+        uid_map = self._uid_to_pod
+        if self._uid_source is not None:
+            try:
+                uid_map = self._uid_source.mapping()
+            except Exception as e:  # noqa: BLE001 — names are best-effort
+                # Degrade to uid:<uid> series rather than failing the whole
+                # attribution phase: allocations are still correct.
+                self._uid_map_errors += 1
+                log.warning("uid map unavailable (%s); emitting uid-keyed pods", e)
+                uid_map = self._uid_to_pod
+        return parse_checkpoint(raw, uid_map)

@@ -454,6 +454,13 @@ class Collector:
             self._counters.inc(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name, (source,))
         for lv, v in self._counters.items_for(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL.name):
             b.add(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL, v, lv)
+        # Side-channel error counters a provider tracks itself (e.g. the
+        # checkpoint path's uid-map fetch failures, which degrade to
+        # last-good data without raising into a poll phase).
+        attr_errors = getattr(self._attribution, "error_counters", None)
+        if callable(attr_errors):
+            for source, v in attr_errors().items():
+                b.add(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL, float(v), (source,))
         polls = self._counters.inc(schema.TPU_EXPORTER_POLLS_TOTAL.name, ())
         b.add(schema.TPU_EXPORTER_POLLS_TOTAL, polls)
         b.add(

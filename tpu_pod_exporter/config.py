@@ -28,6 +28,13 @@ class ExporterConfig:
     record_to: str = ""            # if set, record every poll's samples here
     podresources_socket: str = "/var/lib/kubelet/pod-resources/kubelet.sock"
     checkpoint_path: str = "/var/lib/kubelet/device-plugins/kubelet_internal_checkpoint"
+    # UID→(name, namespace) source for the checkpoint fallback, so it can
+    # emit real pod names instead of pod="uid:<uid>". File wins if both set.
+    uid_map_file: str = ""         # static JSON {"<uid>": {"name","namespace"}}
+    kubelet_pods_url: str = ""     # e.g. https://127.0.0.1:10250/pods
+    kubelet_token_file: str = ""   # bearer token (default SA token if https)
+    kubelet_ca_file: str = ""      # CA bundle; unset = skip verify (node-local)
+    kubelet_pods_refresh_s: float = 30.0
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0
     process_metrics: bool = False  # procfs scan: which host pids hold which chips

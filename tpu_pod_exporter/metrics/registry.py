@@ -409,6 +409,16 @@ class Snapshot:
         the cached bytes, not a second render."""
         if self._openmetrics is not None:
             return self._openmetrics
+
+        def _rewrite(body: bytes, old: bytes, new: bytes) -> bytes:
+            # Anchor the needle on a line start so a HELP text that happens
+            # to *contain* "# HELP <name> " can never be rewritten instead
+            # of the real header line; the first family's header has no
+            # preceding newline and is handled via startswith.
+            if body.startswith(old):
+                return new + body[len(old):]
+            return body.replace(b"\n" + old, b"\n" + new, 1)
+
         with self._gzip_lock:
             if self._openmetrics is None:
                 om = self.encode()
@@ -416,14 +426,15 @@ class Snapshot:
                     spec = fam.spec
                     if spec.type == COUNTER and spec.name.endswith("_total"):
                         base = spec.name[: -len("_total")]
-                        om = om.replace(
+                        om = _rewrite(
+                            om,
                             f"# HELP {spec.name} ".encode(),
                             f"# HELP {base} ".encode(),
-                            1,
-                        ).replace(
+                        )
+                        om = _rewrite(
+                            om,
                             f"# TYPE {spec.name} counter".encode(),
                             f"# TYPE {base} counter".encode(),
-                            1,
                         )
                 self._openmetrics = om + b"# EOF\n"
         return self._openmetrics

@@ -43,6 +43,12 @@ _CONTAINER_ID_RE = re.compile(
 
 DEFAULT_DEVICE_PREFIXES = ("/dev/accel", "/dev/vfio/")
 
+# The shared vfio *container* node — every vfio-using process holds it open
+# (including non-TPU passthrough users), so treating it as a device would
+# inflate the holder/verify set on mixed nodes. Only /dev/vfio/<group>
+# numeric entries identify an actual passthrough device.
+EXCLUDED_DEVICE_PATHS = frozenset({"/dev/vfio/vfio"})
+
 
 class ProcScanError(RuntimeError):
     """The proc root itself was unreadable — the *whole scan* failed (vs. a
@@ -220,6 +226,10 @@ class ProcScanner:
             parts = rec.split("\t")
             if len(parts) != 3 or not parts[0].isdigit():
                 continue
+            if parts[1] in EXCLUDED_DEVICE_PATHS:
+                # The native walk is a pure prefix matcher; the exclusion
+                # rule lives here so Python and native scans agree.
+                continue
             pid = int(parts[0])
             by_pid.setdefault(pid, []).append(parts[1])
             comms[pid] = parts[2]
@@ -260,7 +270,11 @@ class ProcScanner:
                 # what this metric exists to expose.
                 if target.endswith(" (deleted)"):
                     target = target[: -len(" (deleted)")]
-                if target.startswith(self._prefixes) and target not in device_paths:
+                if (
+                    target.startswith(self._prefixes)
+                    and target not in EXCLUDED_DEVICE_PATHS
+                    and target not in device_paths
+                ):
                     device_paths.append(target)
         except OSError:
             return ()

@@ -32,6 +32,38 @@ CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
 
+def accepts_openmetrics(accept: str) -> bool:
+    """Whether content negotiation should pick OpenMetrics over plain text.
+
+    A real (if minimal) q-value parse rather than a substring test
+    (RFC 9110 §12.4.2 subset): OpenMetrics is served only when its q is
+    positive AND at least the q the client gave ``text/plain`` (directly or
+    via a wildcard) — a client sending ``text/plain;q=1,
+    application/openmetrics-text;q=0.1`` deliberately prefers text and must
+    get it. Malformed q-values count as q=1; unlisted types inherit the
+    wildcard q, if any.
+    """
+    qs: dict[str, float] = {}
+    for entry in accept.split(","):
+        parts = entry.split(";")
+        mtype = parts[0].strip().lower()
+        if not mtype:
+            continue
+        q = 1.0
+        for param in parts[1:]:
+            name, _, value = param.partition("=")
+            if name.strip().lower() == "q":
+                try:
+                    q = float(value.strip())
+                except ValueError:
+                    q = 1.0
+        qs[mtype] = max(q, qs.get(mtype, 0.0))
+    wildcard = max(qs.get("*/*", 0.0), qs.get("text/*", 0.0))
+    q_om = qs.get("application/openmetrics-text", 0.0)
+    q_text = qs.get("text/plain", wildcard)
+    return q_om > 0.0 and q_om >= q_text
+
+
 class _Handler(BaseHTTPRequestHandler):
     # set by server factory
     store: SnapshotStore
@@ -90,10 +122,8 @@ class _Handler(BaseHTTPRequestHandler):
         snap = self.store.current()
         # Content negotiation: Prometheus ≥2.5 advertises OpenMetrics in
         # Accept; both formats are served from lazily-cached bytes, so the
-        # negotiation costs a header check, not a render.
-        openmetrics = "application/openmetrics-text" in (
-            self.headers.get("Accept") or ""
-        )
+        # negotiation costs a header parse, not a render.
+        openmetrics = accepts_openmetrics(self.headers.get("Accept") or "")
         headers = [
             ("Content-Type", OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE)
         ]
