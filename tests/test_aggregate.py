@@ -320,3 +320,31 @@ class TestDefaultFetch:
             assert len(fams["tpu_hbm_used_bytes"]) == 2
         finally:
             server.stop()
+
+
+class TestParserRobustness:
+    def test_unterminated_value_raises_fast(self):
+        # The naive value regex backtracked exponentially here; must raise
+        # ParseError in well under a second, not hang the aggregation round.
+        import time
+
+        bad = 'm{a="' + "x" * 60 + '} 1\n'
+        t0 = time.perf_counter()
+        with pytest.raises(ParseError):
+            list(parse_exposition(bad))
+        assert time.perf_counter() - t0 < 1.0
+
+    def test_oversized_label_block_parses_but_is_not_cached(self):
+        from tpu_pod_exporter.metrics import parse as parse_mod
+
+        big = 'm{a="' + "y" * 5000 + '"} 1\n'
+        (s,) = parse_exposition(big)
+        assert len(s.labels["a"]) == 5000
+        assert ('a="' + "y" * 5000 + '"') not in parse_mod._BLOCK_CACHE
+
+    def test_block_cache_returns_fresh_copies(self):
+        text = 'm{a="x"} 1\n'
+        (s1,) = parse_exposition(text)
+        s1.labels["mutated"] = "yes"
+        (s2,) = parse_exposition(text)
+        assert s2.labels == {"a": "x"}
