@@ -348,3 +348,15 @@ class TestParserRobustness:
         s1.labels["mutated"] = "yes"
         (s2,) = parse_exposition(text)
         assert s2.labels == {"a": "x"}
+
+    def test_separator_leniency_grandfathered(self):
+        # The historical per-character parser accepted any run of ", " as a
+        # pair separator; the regex parser must keep that grammar.
+        for text in (
+            'm{a="x" b="y"} 1\n',     # space-separated
+            'm{a="x",,b="y"} 1\n',    # doubled comma
+            'm{a="x", b="y",} 1\n',   # trailing comma
+            'm{a="x"b="y"} 1\n',      # no separator at all
+        ):
+            (s,) = parse_exposition(text)
+            assert s.labels == {"a": "x", "b": "y"}, text

@@ -49,23 +49,35 @@ class FakeChipScript:
     def _resolve(self, v, step: int) -> float:
         return float(v(step)) if callable(v) else float(v)
 
-    def sample(self, info: ChipInfo, step: int) -> ChipSample:
+    def sample(
+        self, info: ChipInfo, step: int, link_cache: dict | None = None
+    ) -> ChipSample:
         duty = None
         if self.duty_cycle_percent is not None:
             duty = self._resolve(self.duty_cycle_percent, step)
         per_step = self._resolve(self.ici_bytes_per_step, step)
-        total = per_step * (step + 1)
-        ids = self._LINK_IDS
-        if self.ici_link_count > len(ids):
-            ids = tuple(str(i) for i in range(self.ici_link_count))
-        # tuple.__new__ bypasses the generated NamedTuple __new__ (a Python
-        # function): at bench scale (256 chips × 6 links × 1 s) the fake's
-        # own construction cost must stay out of the exporter's CPU budget.
-        mk = tuple.__new__
-        links = tuple(
-            mk(IciLinkSample, (ids[li], total))
-            for li in range(self.ici_link_count)
-        )
+        links = None
+        if link_cache is not None:
+            # Link tuples are immutable and identical for every chip sharing
+            # (per-step rate, link count) — share one tuple across the host
+            # instead of allocating chips × links samples per poll (the
+            # fake's own construction cost must stay out of the exporter's
+            # CPU budget at 256-chip bench scale).
+            links = link_cache.get((per_step, self.ici_link_count))
+        if links is None:
+            total = per_step * (step + 1)
+            ids = self._LINK_IDS
+            if self.ici_link_count > len(ids):
+                ids = tuple(str(i) for i in range(self.ici_link_count))
+            # tuple.__new__ bypasses the generated NamedTuple __new__
+            # (a Python function).
+            mk = tuple.__new__
+            links = tuple(
+                mk(IciLinkSample, (ids[li], total))
+                for li in range(self.ici_link_count)
+            )
+            if link_cache is not None:
+                link_cache[(per_step, self.ici_link_count)] = links
         peak = None
         if self.hbm_peak_bytes is not None:
             peak = self._resolve(self.hbm_peak_bytes, step)
@@ -133,8 +145,9 @@ class FakeBackend(DeviceBackend):
             step = self._step
             self._step += 1
             partial = tuple(self._partial_errors)
+        link_cache: dict = {}  # per-poll: shared link tuples across chips
         chips = tuple(
-            script.sample(info, step)
+            script.sample(info, step, link_cache)
             for info, script in zip(self._infos, self._scripts)
         )
         return HostSample(chips=chips, partial_errors=partial)

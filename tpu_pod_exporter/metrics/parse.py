@@ -25,13 +25,15 @@ class ParseError(ValueError):
 
 
 # One label pair: name="value" with the exposition escapes (\\ \" \n)
-# allowed inside the value, followed by a separator or end-of-block.
+# allowed inside the value, followed by any run of comma/whitespace
+# separators ([,\s]* — matching the historical parser's leniency: space-
+# separated pairs, doubled commas, and trailing separators all parse).
 # The value uses the *unrolled* form [^"\\]*(?:\\.[^"\\]*)* — the naive
 # (?:[^"\\]+|\\.)* has a nested-quantifier ambiguity that backtracks
 # exponentially on an unterminated value (a ~30-char bad line would hang
 # the aggregator instead of raising ParseError). Validation is positional:
 # every match must start exactly where the previous one ended.
-_PAIR_RE = re.compile(r'\s*([^=,\s{}]+)\s*=\s*"([^"\\]*(?:\\.[^"\\]*)*)"\s*(?:,|$)')
+_PAIR_RE = re.compile(r'\s*([^=,\s{}]+)\s*=\s*"([^"\\]*(?:\\.[^"\\]*)*)"[,\s]*')
 _UNESCAPE_RE = re.compile(r"\\(.)")
 _ESCAPE_MAP = {"n": "\n", '"': '"', "\\": "\\"}
 
@@ -65,9 +67,17 @@ def _parse_block_uncached(block: str, line: str) -> dict[str, str]:
 # workloads just re-warm in one round — and a per-entry length guard so
 # adversarial/degenerate blocks can't occupy the budget.
 _BLOCK_CACHE: dict[str, dict[str, str]] = {}
-_BLOCK_CACHE_MAX_BYTES = 32 << 20
+_BLOCK_CACHE_MAX_BYTES = 32 << 20  # approximate *resident* bytes
 _BLOCK_CACHE_MAX_ENTRY = 1 << 10
 _block_cache_bytes = 0
+
+
+def _entry_cost(block: str) -> int:
+    """Approximate resident cost of one cache entry. The parsed value dict
+    dominates (measured ~8x the key length: dict header + per-label key and
+    value string objects), so counting key characters alone would let the
+    'budget' admit ~8x its nominal size."""
+    return 200 + 8 * len(block)
 
 
 def _parse_label_block(block: str, line: str) -> dict[str, str]:
@@ -82,7 +92,7 @@ def _parse_label_block(block: str, line: str) -> dict[str, str]:
                 _BLOCK_CACHE.clear()
                 _block_cache_bytes = 0
             _BLOCK_CACHE[block] = cached
-            _block_cache_bytes += len(block)
+            _block_cache_bytes += _entry_cost(block)
     # Copy: callers own their labels dict (ParsedSample is public API).
     return dict(cached)
 
