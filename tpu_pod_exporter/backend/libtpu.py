@@ -40,11 +40,23 @@ DEFAULT_ADDR = "localhost:8431"
 HBM_USAGE = "tpu.runtime.hbm.memory.usage.bytes"
 HBM_TOTAL = "tpu.runtime.hbm.memory.total.bytes"
 DUTY_CYCLE = "tpu.runtime.tensorcore.dutycycle.percent"
-# Optional — not all runtime versions export ICI counters; probed once and
-# skipped thereafter if unsupported.
+# Optional — not all runtime versions export ICI counters, and the exact
+# public name is unconfirmed until probed on real hardware (VERDICT r1 #3).
+# Candidates are tried in order: first via ListSupportedMetrics when the
+# runtime implements it, else by direct GetRuntimeMetric probes; the first
+# hit is remembered for the life of the backend.
 ICI_TRANSFERRED = "tpu.runtime.ici.transferred.bytes"
+ICI_CANDIDATES = (
+    ICI_TRANSFERRED,
+    "tpu.runtime.ici.traffic.bytes",
+    "tpu.runtime.interconnect.transferred.bytes",
+    "megascale.ici.transferred.bytes",
+)
 
 GET_METRIC_METHOD = "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
+LIST_METRICS_METHOD = (
+    "/tpu.monitoring.runtime.RuntimeMetricService/ListSupportedMetrics"
+)
 
 
 def gauge_value(metric) -> float:
@@ -98,7 +110,14 @@ class LibtpuMetricsBackend(DeviceBackend):
         self._lock = threading.Lock()
         self._channel = None
         self._get = None
-        self._ici_supported: bool | None = None  # probed on first sample
+        self._list = None
+        # None = unprobed; False = affirmatively unsupported; str = the
+        # confirmed metric name to query every poll.
+        self._ici_metric: str | None | bool = None
+        # A name that was confirmed and then NOT_FOUND on query (stale
+        # enumeration table / runtime swap). Excluded from rediscovery so
+        # an inconsistent runtime can't flap discover→fail every poll.
+        self._ici_vanished: set[str] = set()
         if device_paths is None:
             import re
 
@@ -129,13 +148,89 @@ class LibtpuMetricsBackend(DeviceBackend):
                 request_serializer=self._pb.MetricRequest.SerializeToString,
                 response_deserializer=self._pb.MetricResponse.FromString,
             )
+            self._list = self._channel.unary_unary(
+                LIST_METRICS_METHOD,
+                request_serializer=(
+                    self._pb.ListSupportedMetricsRequest.SerializeToString
+                ),
+                response_deserializer=(
+                    self._pb.ListSupportedMetricsResponse.FromString
+                ),
+            )
+
+    def query_raw(self, metric_name: str, timeout_s: float | None = None):
+        """Public raw GetRuntimeMetric — returns the MetricResponse message.
+        The probe tool builds on this so the RPC plumbing has one owner."""
+        self._ensure_channel()
+        return self._get(
+            self._pb.MetricRequest(metric_name=metric_name),
+            timeout=self._timeout_s if timeout_s is None else timeout_s,
+        )
 
     def _query(self, metric_name: str) -> dict[str, float]:
+        return rows_by_device(self.query_raw(metric_name))
+
+    def list_supported_metrics(self) -> list[str] | None:
+        """Names the runtime serves, or None when the runtime does not
+        implement the enumeration RPC (older libtpu)."""
         self._ensure_channel()
-        resp = self._get(
-            self._pb.MetricRequest(metric_name=metric_name), timeout=self._timeout_s
-        )
-        return rows_by_device(resp)
+        try:
+            resp = self._list(
+                self._pb.ListSupportedMetricsRequest(), timeout=self._timeout_s
+            )
+        except self._grpc.RpcError as e:
+            if e.code() in (
+                self._grpc.StatusCode.UNIMPLEMENTED,
+                self._grpc.StatusCode.NOT_FOUND,
+            ):
+                return None
+            raise
+        return [m.metric_name for m in resp.supported_metric]
+
+    def _resolve_ici_metric(self) -> dict[str, float] | None:
+        """One-time discovery of the ICI counter's real name. Sets
+        ``self._ici_metric`` to the confirmed name, or False when the
+        runtime affirmatively serves none of the candidates. Returns the
+        metric rows when discovery already fetched them (the probe path),
+        so the first poll doesn't issue the same RPC twice. Raises on
+        transient errors (leaves the probe un-latched for the next poll).
+        Names in ``self._ici_vanished`` are excluded — see __init__."""
+        candidates = [n for n in ICI_CANDIDATES if n not in self._ici_vanished]
+        supported = self.list_supported_metrics()
+        if supported is not None:
+            for name in candidates:
+                if name in supported:
+                    self._ici_metric = name
+                    log.info("ICI counter confirmed via enumeration: %s", name)
+                    return None
+            # Nothing named like our candidates; surface what looked ICI-ish
+            # so an operator can extend ICI_CANDIDATES from the logs.
+            icish = [n for n in supported if "ici" in n.lower()]
+            log.info(
+                "no known ICI counter in %d supported metrics%s",
+                len(supported),
+                f"; ici-like names: {icish}" if icish else "",
+            )
+            self._ici_metric = False
+            return None
+        # No enumeration RPC: probe candidates directly.
+        for name in candidates:
+            try:
+                rows = self._query(name)
+                self._ici_metric = name
+                log.info("ICI counter confirmed by probe: %s", name)
+                return rows
+            except self._grpc.RpcError as e:
+                if e.code() in (
+                    self._grpc.StatusCode.NOT_FOUND,
+                    self._grpc.StatusCode.UNIMPLEMENTED,
+                    self._grpc.StatusCode.INVALID_ARGUMENT,
+                ):
+                    continue  # affirmatively not this name; try the next
+                raise  # transient — retry the whole probe next poll
+        log.info("ICI counters unsupported by this runtime (all candidates)")
+        self._ici_metric = False
+        return None
 
     def sample(self) -> HostSample:
         partial: list[str] = []
@@ -156,26 +251,39 @@ class LibtpuMetricsBackend(DeviceBackend):
             partial.append(f"duty-cycle query failed: {e}")
 
         ici: dict[str, float] = {}
-        if self._ici_supported is not False:
+        discovered_rows: dict[str, float] | None = None
+        if self._ici_metric is None:
             try:
-                ici = self._query(ICI_TRANSFERRED)
-                self._ici_supported = True
-            except Exception as e:  # noqa: BLE001
-                code = getattr(e, "code", lambda: None)()
-                unsupported = code in (
-                    self._grpc.StatusCode.NOT_FOUND,
-                    self._grpc.StatusCode.UNIMPLEMENTED,
-                    self._grpc.StatusCode.INVALID_ARGUMENT,
-                )
-                if self._ici_supported is None and unsupported:
-                    # The runtime affirmatively does not export this metric:
-                    # stop asking.
-                    log.info("ICI counters unsupported by this runtime: %s", e)
-                    self._ici_supported = False
-                else:
-                    # Transient (timeout/unavailable) — whether on the first
-                    # probe or after success, keep retrying and surface it.
-                    partial.append(f"ICI query failed: {e}")
+                discovered_rows = self._resolve_ici_metric()
+            except Exception as e:  # noqa: BLE001 — transient: retry next poll
+                partial.append(f"ICI discovery failed: {e}")
+        if isinstance(self._ici_metric, str):
+            if discovered_rows is not None:
+                ici = discovered_rows  # probe already fetched this poll's rows
+            else:
+                try:
+                    ici = self._query(self._ici_metric)
+                except Exception as e:  # noqa: BLE001
+                    code = getattr(e, "code", lambda: None)()
+                    if code in (
+                        self._grpc.StatusCode.NOT_FOUND,
+                        self._grpc.StatusCode.UNIMPLEMENTED,
+                        self._grpc.StatusCode.INVALID_ARGUMENT,
+                    ):
+                        # The runtime stopped serving the confirmed name
+                        # (runtime swap, or a stale enumeration table):
+                        # rediscover next poll, excluding this name so an
+                        # inconsistent runtime can't flap forever.
+                        log.info(
+                            "confirmed ICI metric vanished; re-probing "
+                            "without it: %s", e
+                        )
+                        self._ici_vanished.add(self._ici_metric)
+                        self._ici_metric = None
+                    else:
+                        # Transient (timeout/unavailable) — keep the
+                        # confirmed name, surface the failure.
+                        partial.append(f"ICI query failed: {e}")
 
         chips: list[ChipSample] = []
         ordered = sorted(usage, key=_dev_sort_key)
@@ -215,6 +323,7 @@ class LibtpuMetricsBackend(DeviceBackend):
                     pass
             self._channel = None
             self._get = None
+            self._list = None
 
     def close(self) -> None:
         self._reset_channel()
