@@ -306,6 +306,37 @@ class TestIciDiscovery:
         backend.close()
 
 
+    def test_inconsistent_runtime_does_not_flap(self, metric_server):
+        # Enumeration lists the ICI name but GetRuntimeMetric NOT_FOUNDs it
+        # (stale table): one vanish cycle, then latch off — no per-poll
+        # rediscover/fail loop.
+        service, addr = metric_server
+        self._base(service)
+        service.supported = [ICI_TRANSFERRED]  # listed but never served
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        backend.sample()  # confirm -> query NOT_FOUND -> vanish
+        backend.sample()  # rediscover without the vanished name -> latch off
+        backend.sample()
+        backend.sample()
+        assert service.list_calls == 2  # no further discovery attempts
+        assert backend.sample().chips[0].ici_links == ()
+        backend.close()
+
+    def test_probe_fallback_first_poll_queries_confirmed_name_once(
+        self, metric_server
+    ):
+        service, addr = metric_server
+        self._base(service)
+        service.set(ICI_TRANSFERRED, [(0, 9)])  # enumeration UNIMPLEMENTED
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        assert sample.chips[0].ici_links[0].transferred_bytes_total == 9
+        assert service.calls.count(ICI_TRANSFERRED) == 1  # probe rows reused
+        backend.sample()
+        assert service.calls.count(ICI_TRANSFERRED) == 2
+        backend.close()
+
+
 class TestProbeTool:
     def test_probe_with_enumeration(self, metric_server):
         from tpu_pod_exporter.probe import probe
@@ -352,41 +383,6 @@ class TestProbeTool:
         doc = json.loads(out.read_text())
         assert doc["supported"] == [HBM_USAGE]
         assert json.loads(capsys.readouterr().out) == doc
-
-    def _base(self, service):
-        service.set(HBM_USAGE, [(0, GIB)])
-        service.set(HBM_TOTAL, [(0, 32 * GIB)])
-        service.set(DUTY_CYCLE, [(0, 1.0)])
-
-    def test_inconsistent_runtime_does_not_flap(self, metric_server):
-        # Enumeration lists the ICI name but GetRuntimeMetric NOT_FOUNDs it
-        # (stale table): one vanish cycle, then latch off — no per-poll
-        # rediscover/fail loop.
-        service, addr = metric_server
-        self._base(service)
-        service.supported = [ICI_TRANSFERRED]  # listed but never served
-        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
-        backend.sample()  # confirm -> query NOT_FOUND -> vanish
-        backend.sample()  # rediscover without the vanished name -> latch off
-        backend.sample()
-        backend.sample()
-        assert service.list_calls == 2  # no further discovery attempts
-        assert backend.sample().chips[0].ici_links == ()
-        backend.close()
-
-    def test_probe_fallback_first_poll_queries_confirmed_name_once(
-        self, metric_server
-    ):
-        service, addr = metric_server
-        self._base(service)
-        service.set(ICI_TRANSFERRED, [(0, 9)])  # enumeration UNIMPLEMENTED
-        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
-        sample = backend.sample()
-        assert sample.chips[0].ici_links[0].transferred_bytes_total == 9
-        assert service.calls.count(ICI_TRANSFERRED) == 1  # probe rows reused
-        backend.sample()
-        assert service.calls.count(ICI_TRANSFERRED) == 2
-        backend.close()
 
     def test_probe_string_gauge_stays_json_strict(self, metric_server):
         # A string/unset gauge must not become float NaN (json.dumps would

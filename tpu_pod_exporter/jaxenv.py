@@ -37,6 +37,45 @@ import sys
 # Env vars that make sitecustomize register the TPU-tunnel PJRT plugin.
 HAZARD_ENV_VARS = ("PALLAS_AXON_POOL_IPS",)
 
+# Loopback ports the tunnel relay serves when alive (leader :8082, device
+# RPC :8083 — from the plugin's own registration docs). Port liveness is
+# only a *fast negative* signal: nothing listening ⇒ backend init is
+# guaranteed to block; something listening proves nothing (an unrelated
+# dev server may squat the port), so callers must escalate to
+# default_backend_usable() before trusting the tunnel.
+TUNNEL_RELAY_PORTS = (8083, 8082)
+
+
+def tunnel_relay_listening() -> bool:
+    """Whether anything accepts TCP on the tunnel relay ports."""
+    import socket
+
+    for port in TUNNEL_RELAY_PORTS:
+        try:
+            socket.create_connection(("127.0.0.1", port), timeout=1).close()
+            return True
+        except OSError:
+            continue
+    return False
+
+
+def default_backend_usable(timeout_s: float = 120.0) -> bool:
+    """Probe default-platform backend init in a killable child process
+    (inheriting this env verbatim). True iff ``jax.devices()`` completes —
+    the only trustworthy positive signal that the tunnel actually works;
+    an in-process attempt would hang unrecoverably on a wedged tunnel."""
+    import subprocess
+
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s,
+            capture_output=True,
+        )
+        return proc.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
 

@@ -58,12 +58,21 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
         "metrics": {},
         "errors": {},
     }
+    # Transport-level failures (nothing answered); any *other* status code
+    # is a real response from the service and proves reachability — a
+    # runtime that NOT_FOUNDs every name is answering, not unreachable.
+    transport_codes = (
+        grpc.StatusCode.UNAVAILABLE,
+        grpc.StatusCode.DEADLINE_EXCEEDED,
+    )
     try:
         try:
             report["supported"] = backend.list_supported_metrics()
             report["reachable"] = True
         except grpc.RpcError as e:
             report["errors"]["<ListSupportedMetrics>"] = f"{e.code()}: {e.details()}"
+            if e.code() not in transport_codes:
+                report["reachable"] = True
 
         names = report["supported"]
         if names is None:
@@ -74,6 +83,8 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
                 resp = backend.query_raw(name, timeout_s=timeout_s)
             except grpc.RpcError as e:
                 report["errors"][name] = f"{e.code()}: {e.details()}"
+                if e.code() not in transport_codes:
+                    report["reachable"] = True
                 continue
             report["reachable"] = True
             rows = resp.metric.metrics
