@@ -1,0 +1,58 @@
+"""The hardware-validation harness, orchestrated against the fake backend
+(VERDICT r1 #4/#5: the instrument ships and is proven hardware-free; real
+runs produce the round artifact when an accelerator runtime is reachable)."""
+
+import json
+
+from tpu_pod_exporter.hwcheck import main, run_check
+
+
+class TestRunCheck:
+    def test_fake_backend_full_pass(self, tmp_path):
+        trace = tmp_path / "trace.jsonl"
+        report = run_check(
+            backend="fake", idle_s=0.6, load_s=0.8, record_to=str(trace),
+            libtpu_addr=f"unix://{tmp_path}/absent.sock",
+        )
+        assert report["ok"] is True
+        assert report["checks"]["hbm_rises_under_load"] is True
+        assert report["checks"]["hbm_falls_after_release"] is True
+        assert report["checks"]["duty_cycle_responds"] is True
+        assert report["phases"]["load"]["hbm_used_bytes"] > (
+            report["phases"]["idle"]["hbm_used_bytes"]
+        )
+        # the recorded trace captured all three phases end-to-end
+        lines = [json.loads(l) for l in trace.read_text().splitlines()]
+        assert len(lines) >= 3
+        # unreachable libtpu service is documented, not fatal
+        assert report["libtpu"]["reachable"] is False
+
+    def test_fake_backend_failure_detected(self, tmp_path):
+        # A stimulus that does nothing must fail the rise/fall checks —
+        # the harness can't report success for an exporter that ignores load.
+        class Inert:
+            def start(self):
+                pass
+
+            def stop(self):
+                pass
+
+        report = run_check(
+            backend="fake", idle_s=0.4, load_s=0.4,
+            libtpu_addr=f"unix://{tmp_path}/absent.sock",
+            _stimulus=Inert(),
+        )
+        assert report["ok"] is False
+        assert report["checks"]["hbm_rises_under_load"] is False
+
+    def test_cli_writes_artifact(self, tmp_path, capsys):
+        out = tmp_path / "HWCHECK.json"
+        rc = main([
+            "--backend", "fake", "--idle-s", "0.4", "--load-s", "0.5",
+            "--libtpu-addr", f"unix://{tmp_path}/absent.sock",
+            "--out", str(out),
+        ])
+        assert rc == 0
+        doc = json.loads(out.read_text())
+        assert doc["ok"] is True
+        assert json.loads(capsys.readouterr().out) == doc

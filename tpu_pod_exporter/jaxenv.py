@@ -59,22 +59,63 @@ def tunnel_relay_listening() -> bool:
     return False
 
 
-def default_backend_usable(timeout_s: float = 120.0) -> bool:
+# Process-wide memo: the child probe costs seconds (up to its timeout on a
+# squatted-but-dead port), so every guard in one process shares one verdict.
+_default_backend_usable: bool | None = None
+
+
+def default_backend_usable(timeout_s: float = 120.0, refresh: bool = False) -> bool:
     """Probe default-platform backend init in a killable child process
     (inheriting this env verbatim). True iff ``jax.devices()`` completes —
     the only trustworthy positive signal that the tunnel actually works;
-    an in-process attempt would hang unrecoverably on a wedged tunnel."""
-    import subprocess
+    an in-process attempt would hang unrecoverably on a wedged tunnel.
+    Memoized per process (``refresh=True`` re-probes)."""
+    global _default_backend_usable
+    if _default_backend_usable is None or refresh:
+        import subprocess
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s,
-            capture_output=True,
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c", "import jax; jax.devices()"],
+                timeout=timeout_s,
+                capture_output=True,
+            )
+            _default_backend_usable = proc.returncode == 0
+        except subprocess.TimeoutExpired:
+            _default_backend_usable = False
+    return _default_backend_usable
+
+
+def ensure_usable_backend(timeout_s: float = 120.0) -> str:
+    """The guard for anything that initializes JAX *in-process* on this
+    image: returns ``"default"`` when the default platform is safe to
+    initialize, or pins CPU and returns ``"pinned-cpu"`` when the
+    TPU-tunnel env is present but the tunnel is dead (backend init would
+    block forever, round 1's rc=124). Raises with a diagnostic if the pin
+    is impossible. The fallback is logged — a wedged tunnel must be
+    observable, not indistinguishable from a healthy run."""
+    if not any(os.environ.get(v) for v in HAZARD_ENV_VARS):
+        return "default"
+    if tunnel_relay_listening() and default_backend_usable(timeout_s):
+        return "default"
+    if not pin_cpu_inprocess():
+        raise RuntimeError(
+            "TPU tunnel is dead and the CPU pin failed (backends already "
+            "initialized on a non-CPU platform?) — refusing to continue "
+            "into a guaranteed backend-init hang"
         )
-        return proc.returncode == 0
-    except subprocess.TimeoutExpired:
-        return False
+    import logging
+
+    logging.getLogger("tpu_pod_exporter.jaxenv").warning(
+        "TPU tunnel is not usable; JAX pinned to CPU for this process — "
+        "accelerator code paths are NOT being exercised"
+    )
+    print(
+        "[jaxenv] TPU tunnel not usable; pinned JAX to CPU (accelerator "
+        "paths not exercised)",
+        file=sys.stderr,
+    )
+    return "pinned-cpu"
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
