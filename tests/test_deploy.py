@@ -1,0 +1,74 @@
+"""Deploy-manifest drift guards: the YAML must parse, and every `tpu_*`
+metric name referenced in rules/dashboards must exist in the exporter's
+(or aggregator's) schema — a renamed metric must fail CI, not silently
+break dashboards in production."""
+
+import json
+import re
+from pathlib import Path
+
+import pytest
+import yaml
+
+from tpu_pod_exporter.metrics import schema
+
+DEPLOY = Path(__file__).resolve().parent.parent / "deploy"
+
+METRIC_RE = re.compile(r"\btpu_[a-z0-9_]+\b")
+
+# Strings that look like metric names but aren't (app labels, image names).
+NON_METRIC_TOKENS = {"tpu_pod_exporter"}
+
+
+def schema_metric_names() -> set:
+    names = set()
+    for val in vars(schema).values():
+        name = getattr(val, "name", None)
+        if isinstance(name, str) and name.startswith("tpu_"):
+            names.add(name)
+    return names
+
+
+def recorded_rule_names(doc) -> set:
+    """Names minted by Prometheus recording rules in this file."""
+    out = set()
+    for group in (doc or {}).get("groups", []):
+        for rule in group.get("rules", []):
+            record = rule.get("record")
+            if record:
+                out.add(record)
+    return out
+
+
+@pytest.mark.parametrize(
+    "manifest",
+    ["daemonset.yaml", "aggregator.yaml", "prometheus-example.yaml",
+     "prometheus-rules.yaml"],
+)
+def test_manifest_parses(manifest):
+    list(yaml.safe_load_all((DEPLOY / manifest).read_text()))
+
+
+def test_rules_reference_only_schema_metrics():
+    doc = yaml.safe_load((DEPLOY / "prometheus-rules.yaml").read_text())
+    known = schema_metric_names() | recorded_rule_names(doc) | NON_METRIC_TOKENS
+    referenced = set(METRIC_RE.findall((DEPLOY / "prometheus-rules.yaml").read_text()))
+    unknown = referenced - known
+    assert not unknown, f"rules reference metrics the schema never exports: {unknown}"
+
+
+def test_grafana_dashboard_references_only_schema_metrics():
+    text = (DEPLOY / "grafana-dashboard.json").read_text()
+    json.loads(text)  # must be valid JSON at all
+    doc = yaml.safe_load((DEPLOY / "prometheus-rules.yaml").read_text())
+    known = schema_metric_names() | recorded_rule_names(doc) | NON_METRIC_TOKENS
+    unknown = set(METRIC_RE.findall(text)) - known
+    assert not unknown, f"dashboard references unknown metrics: {unknown}"
+
+
+def test_daemonset_probes_match_server_endpoints():
+    docs = list(yaml.safe_load_all((DEPLOY / "daemonset.yaml").read_text()))
+    ds = next(d for d in docs if d and d.get("kind") == "DaemonSet")
+    container = ds["spec"]["template"]["spec"]["containers"][0]
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"

@@ -58,9 +58,11 @@ def _totals(series: dict) -> dict:
         v for (n, _), v in series.items()
         if n == "tpu_tensorcore_duty_cycle_percent"
     ]
+    peaks = [v for (n, _), v in series.items() if n == "tpu_hbm_peak_bytes"]
     return {
         "hbm_used_bytes": used,
         "hbm_total_bytes": total,
+        "hbm_peak_bytes_max": max(peaks) if peaks else None,
         "duty_cycle_max_percent": max(duties) if duties else None,
         "series": len(series),
     }
@@ -222,7 +224,14 @@ def run_check(
             "served_metrics": sorted(lp["metrics"]),
         }
     except Exception as e:  # noqa: BLE001 — the probe must not fail the check
-        report["libtpu"] = {"addr": libtpu_addr, "error": str(e)}
+        # Same shape as the success case so artifact consumers never fork.
+        report["libtpu"] = {
+            "addr": libtpu_addr,
+            "reachable": False,
+            "supported": None,
+            "served_metrics": [],
+            "error": str(e),
+        }
     return report
 
 

@@ -240,6 +240,61 @@ def reference_moe(expert_w, x):
     return jnp.tanh(per_expert[jnp.arange(t), idx])
 
 
+# --------------------------------------------------------------------- fsdp
+
+def fsdp_step_fn(mesh, axis: str = "shard", lr: float = 0.1):
+    """FSDP-style sharded data parallelism: each device owns a row-shard of
+    the weight and a batch-shard of the data. Forward ``all_gather``s the
+    full weight (fan-in ICI); autodiff of the tiled all_gather lowers the
+    weight gradient to ``reduce_scatter`` (fan-out) — together the one
+    collective pair the other loadgen programs don't produce (ring
+    ppermute, pipeline ppermute, MoE all_to_all, dp×tp psum).
+
+    Returns ``fn(w_shard, x, y) -> (new_w_shard, loss)`` with everything
+    sharded over ``axis`` except the (replicated) scalar loss."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    n = mesh.shape[axis]
+
+    def local(w_shard, x, y):
+        # w_shard: (d/n, d); x, y: (b/n, d)
+        def local_loss(ws):
+            w = lax.all_gather(ws, axis, axis=0, tiled=True)  # (d, d)
+            pred = jnp.tanh(x @ w)
+            return jnp.mean((pred - y) ** 2)
+
+        loss, g = jax.value_and_grad(local_loss)(w_shard)
+        loss = lax.pmean(loss, axis)  # global loss = mean of shard losses
+        # The tiled all_gather's transpose is reduce_scatter: g already
+        # holds the cross-device SUM of cotangents for *this* shard, so
+        # the data-parallel mean is a plain /n — a pmean here would
+        # wrongly average together grads of different shards.
+        return w_shard - lr * (g / n), loss
+
+    sm = _shard_map()
+    fn = sm(local, mesh=mesh,
+            in_specs=(P(axis, None), P(axis, None), P(axis, None)),
+            out_specs=(P(axis, None), P()))
+    return jax.jit(fn), NamedSharding(mesh, P(axis, None))
+
+
+def reference_fsdp(w, x, y, lr: float = 0.1):
+    """Dense single-device step — ground truth for fsdp_step_fn (highest-
+    precision dots; see reference_attention)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_of(wf):
+        pred = jnp.tanh(jnp.matmul(x, wf, precision="highest"))
+        return jnp.mean((pred - y) ** 2)
+
+    loss, g = jax.value_and_grad(loss_of)(w)
+    return w - lr * g, loss
+
+
 # ------------------------------------------------------------------- dryrun
 
 def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
@@ -287,4 +342,17 @@ def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
         jax.random.normal(key, (tokens, d_moe), jnp.float32), x_sharding
     )
     results["moe"] = float(jnp.sum(fn(expert_w, x)))
+
+    # FSDP: all_gather forward / reduce_scatter backward over a "shard" axis.
+    mesh = make_1d_mesh(n_devices, "shard")
+    fn, w_sharding = fsdp_step_fn(mesh)
+    d_f = 2 * n_devices
+    w = jax.device_put(
+        jax.random.normal(key, (d_f, d_f), jnp.float32) * 0.3, w_sharding
+    )
+    xb = jax.device_put(jax.random.normal(key, (4 * n_devices, d_f), jnp.float32),
+                        w_sharding)
+    yb = jax.device_put(jnp.zeros((4 * n_devices, d_f), jnp.float32), w_sharding)
+    _, loss = fn(w, xb, yb)
+    results["fsdp"] = float(loss)
     return results

@@ -189,6 +189,40 @@ def check_moe(n: int) -> dict:
     return _close(out, reference_moe(expert_w, x), rtol=2e-4, atol=2e-4)
 
 
+def check_fsdp(n: int) -> dict:
+    """Sharded FSDP step (all_gather fwd / reduce_scatter bwd) must match
+    the dense single-device SGD step."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        fsdp_step_fn,
+        make_1d_mesh,
+        reference_fsdp,
+    )
+
+    mesh = make_1d_mesh(n, "shard")
+    fn, sharding = fsdp_step_fn(mesh)
+    d, b = 2 * n, 4 * n
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(11), 3)
+    w = 0.3 * jax.random.normal(k1, (d, d), jnp.float32)
+    x = jax.random.normal(k2, (b, d), jnp.float32)
+    y = jax.random.normal(k3, (b, d), jnp.float32)
+    new_w, loss = fn(
+        jax.device_put(w, sharding),
+        jax.device_put(x, sharding),
+        jax.device_put(y, sharding),
+    )
+    ref_w, ref_loss = reference_fsdp(w, x, y)
+    res = _close(new_w, ref_w, rtol=2e-5, atol=2e-5)
+    loss_err = abs(float(loss) - float(ref_loss))
+    return {
+        **res,
+        "ok": res["ok"] and loss_err < 1e-5,
+        "loss_abs_err": loss_err,
+    }
+
+
 def check_sharded_descends(n: int) -> dict:
     """SGD on a fixed batch must strictly descend over 5 steps."""
     import numpy as np
@@ -223,6 +257,7 @@ CHECKS = {
     "ring_attention_stability": check_ring_attention_stability,
     "pipeline": check_pipeline,
     "moe": check_moe,
+    "fsdp": check_fsdp,
     "sharded_descends": check_sharded_descends,
     "flagship": check_flagship,
 }
