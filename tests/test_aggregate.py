@@ -360,3 +360,11 @@ class TestParserRobustness:
         ):
             (s,) = parse_exposition(text)
             assert s.labels == {"a": "x", "b": "y"}, text
+
+    def test_round_duration_self_metric(self):
+        # uses the TestSliceAggregator-style setup inline: one good target
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages)).poll_once()
+        dur = store.current().value("tpu_aggregator_round_duration_seconds", {})
+        assert dur is not None and 0.0 <= dur < 60.0

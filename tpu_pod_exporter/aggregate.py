@@ -114,10 +114,11 @@ class SliceAggregator:
     # ------------------------------------------------------------------ round
 
     def poll_once(self) -> None:
+        t0 = time.monotonic()
         results = list(
             self._pool.map(self._scrape_one, self._targets)
         )  # [(target, text|None, duration_s)]
-        self._publish(results)
+        self._publish(results, round_started=t0)
 
     def _scrape_one(self, target: str) -> tuple[str, str | None, float]:
         t0 = time.monotonic()
@@ -130,7 +131,7 @@ class SliceAggregator:
 
     # ---------------------------------------------------------------- publish
 
-    def _publish(self, results) -> None:
+    def _publish(self, results, round_started: float | None = None) -> None:
         b = SnapshotBuilder()
         for spec in schema.AGGREGATE_SPECS:
             b.declare(spec)
@@ -186,6 +187,11 @@ class SliceAggregator:
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
+        if round_started is not None:
+            b.add(
+                schema.TPU_AGG_ROUND_DURATION_SECONDS,
+                time.monotonic() - round_started,
+            )
         self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
 
     @staticmethod

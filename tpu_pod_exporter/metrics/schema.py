@@ -384,6 +384,12 @@ TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS = MetricSpec(
     type=GAUGE,
 )
 
+TPU_AGG_ROUND_DURATION_SECONDS = MetricSpec(
+    name="tpu_aggregator_round_duration_seconds",
+    help="Wall time of the last full aggregation round (all targets: scrape + parse + fold + publish); budgeted in BASELINE.md.",
+    type=GAUGE,
+)
+
 AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_SLICE_HOSTS_REPORTING,
     TPU_SLICE_CHIP_COUNT,
@@ -399,6 +405,7 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_SCRAPE_DURATION_SECONDS,
     TPU_AGG_SCRAPE_ERRORS_TOTAL,
     TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS,
+    TPU_AGG_ROUND_DURATION_SECONDS,
 )
 
 
