@@ -1,0 +1,76 @@
+"""Tunnel liveness watchdog: log TPU-tunnel state transitions over time.
+
+The experimental TPU tunnel on this machine has flipped between dead
+(all of round 2 — see HARDWARE.md) and live (round 3 judging, round 4
+start) with no notice. This watchdog samples the cheap liveness signals
+every ``--interval`` seconds and appends a JSONL record *only on state
+change* (plus one initial record and a periodic heartbeat), so a whole
+round of watching stays a few KiB and the resulting log is a committed
+timeline of hardware availability.
+
+Signals sampled (cheapest first; none can hang):
+- ``relay``: TCP accept on the tunnel relay ports (jaxenv.TUNNEL_RELAY_PORTS)
+- ``libtpu_8431``: TCP accept on the libtpu runtime-metrics gRPC port
+
+Neither signal initializes JAX — a wedged tunnel cannot wedge the
+watchdog. Full ``default_backend_usable()`` probes stay manual (they
+cost a subprocess + backend init) and are recorded by hwcheck/probe runs.
+
+Reference contrast: the reference assumes NVML is always present and
+fatally exits otherwise (main.go:44-48); here availability is itself a
+time-varying observable worth recording.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import socket
+import time
+
+
+def _port_open(port: int, timeout: float = 1.0) -> bool:
+    try:
+        socket.create_connection(("127.0.0.1", port), timeout=timeout).close()
+        return True
+    except OSError:
+        return False
+
+
+def sample() -> dict:
+    from tpu_pod_exporter.jaxenv import TUNNEL_RELAY_PORTS
+
+    return {
+        "relay": any(_port_open(p) for p in TUNNEL_RELAY_PORTS),
+        "libtpu_8431": _port_open(8431),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--out", default="tunnel-watch.jsonl")
+    p.add_argument("--interval", type=float, default=60.0)
+    p.add_argument("--heartbeat-every", type=int, default=60,
+                   help="emit a heartbeat record every N samples even without change")
+    p.add_argument("--max-seconds", type=float, default=0.0,
+                   help="stop after this long (0 = run forever)")
+    args = p.parse_args(argv)
+
+    deadline = time.monotonic() + args.max_seconds if args.max_seconds else None
+    prev = None
+    n = 0
+    while deadline is None or time.monotonic() < deadline:
+        state = sample()
+        n += 1
+        if state != prev or (n % args.heartbeat_every) == 1:
+            rec = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                   "change": state != prev, **state}
+            with open(args.out, "a") as f:
+                f.write(json.dumps(rec) + "\n")
+            prev = state
+        time.sleep(args.interval)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
