@@ -73,8 +73,13 @@ class ChipSample(NamedTuple):
     """One chip's telemetry at one instant. (NamedTuple — see IciLinkSample.)"""
 
     info: ChipInfo
-    hbm_used_bytes: float
-    hbm_total_bytes: float
+    # None means "this backend could not read HBM for this chip" (e.g. the
+    # experimental TPU tunnel serves empty memory_stats — see HARDWARE.md).
+    # The collector then publishes NO hbm series for the chip, matching the
+    # reference's never-publish-what-you-didn't-read rule (main.go:129-132);
+    # a literal 0.0 is reserved for a real idle-zero reading.
+    hbm_used_bytes: float | None
+    hbm_total_bytes: float | None
     tensorcore_duty_cycle_percent: float | None = None
     ici_links: tuple[IciLinkSample, ...] = ()
     # Allocator high-water mark since runtime start (jaxdev:

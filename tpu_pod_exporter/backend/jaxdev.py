@@ -54,20 +54,29 @@ class JaxDeviceBackend(DeviceBackend):
         chips: list[ChipSample] = []
         partial: list[str] = []
         for d in devices:
-            used = 0.0
-            total = 0.0
+            used = None
+            total = None
             peak = None
             try:
                 stats = d.memory_stats()
-                if stats is None:  # some runtimes (tunnels, CPU) expose none
-                    partial.append(f"device {d.id}: memory_stats returned None")
-                    stats = {}
-                used = float(stats.get("bytes_in_use", 0))
-                total = float(
-                    stats.get("bytes_limit", stats.get("bytes_reservable_limit", 0))
-                )
-                if "peak_bytes_in_use" in stats:
-                    peak = float(stats["peak_bytes_in_use"])
+                if not stats:
+                    # None (CPU) and {} (the experimental TPU tunnel — seen
+                    # live, tests/fixtures/real-trace.jsonl) both mean "not
+                    # readable here". Leave used/total None so the collector
+                    # publishes nothing rather than a fake idle-zero.
+                    partial.append(
+                        f"device {d.id}: memory_stats "
+                        + ("returned None" if stats is None else "empty")
+                    )
+                else:
+                    if "bytes_in_use" in stats:
+                        used = float(stats["bytes_in_use"])
+                    if "bytes_limit" in stats or "bytes_reservable_limit" in stats:
+                        total = float(
+                            stats.get("bytes_limit", stats.get("bytes_reservable_limit"))
+                        )
+                    if "peak_bytes_in_use" in stats:
+                        peak = float(stats["peak_bytes_in_use"])
             except Exception as e:  # noqa: BLE001 — CPU devices raise; report once
                 partial.append(f"device {d.id}: memory_stats unavailable: {e}")
             coords = getattr(d, "coords", None)

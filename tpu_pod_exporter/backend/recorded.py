@@ -69,8 +69,12 @@ def sample_from_dict(doc: dict) -> HostSample:
                     device_kind=c.get("device_kind", ""),
                     coords=c.get("coords", ""),
                 ),
-                hbm_used_bytes=float(c["hbm_used"]),
-                hbm_total_bytes=float(c["hbm_total"]),
+                hbm_used_bytes=(
+                    None if c["hbm_used"] is None else float(c["hbm_used"])
+                ),
+                hbm_total_bytes=(
+                    None if c["hbm_total"] is None else float(c["hbm_total"])
+                ),
                 tensorcore_duty_cycle_percent=(
                     None if c.get("duty") is None else float(c["duty"])
                 ),

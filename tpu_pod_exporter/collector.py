@@ -341,14 +341,20 @@ class Collector:
                     cached = (chip_tuple, {}, info_tuple)
                     label_cache[cache_key] = cached
                 chip_tuple, link_tuples, info_tuple = cached
+                # None = backend couldn't read HBM (tunnel with empty
+                # memory_stats): publish no series — absent beats fake-zero
+                # (main.go:129-132 never publishes an unread value).
                 used = chip.hbm_used_bytes
                 total_b = chip.hbm_total_bytes
-                hbm_used_s[chip_tuple] = used
-                hbm_total_s[chip_tuple] = total_b
-                # hbm_used_percent inlined (analog of main.go:149-150).
-                hbm_pct_s[chip_tuple] = (
-                    used / total_b * 100.0 if total_b > 0 else 0.0
-                )
+                if used is not None:
+                    hbm_used_s[chip_tuple] = used
+                if total_b is not None:
+                    hbm_total_s[chip_tuple] = total_b
+                if used is not None and total_b is not None:
+                    # hbm_used_percent inlined (analog of main.go:149-150).
+                    hbm_pct_s[chip_tuple] = (
+                        used / total_b * 100.0 if total_b > 0 else 0.0
+                    )
                 if chip.hbm_peak_bytes is not None:
                     hbm_peak_s[chip_tuple] = chip.hbm_peak_bytes
                 if chip.tensorcore_duty_cycle_percent is not None:
@@ -395,8 +401,8 @@ class Collector:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
                     agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0.0])
                     agg[0] += 1.0
-                    agg[1] += chip.hbm_used_bytes
-                    agg[2] += chip.hbm_total_bytes
+                    agg[1] += used or 0.0
+                    agg[2] += total_b or 0.0
                     if self._legacy_metrics:
                         # The legacy shape has no namespace label (the
                         # reference collided on pod name, main.go:113); sum
@@ -407,8 +413,8 @@ class Collector:
                         # workers; "" otherwise.
                         pid = str(chip_holders[0].pid) if chip_holders else ""
                         lagg = legacy_rollup.setdefault((owner.pod, pid), [0.0, 0.0])
-                        lagg[0] += used
-                        lagg[1] += total_b
+                        lagg[0] += used or 0.0
+                        lagg[1] += total_b or 0.0
 
             if fast:
                 self._fold_ici_fast(ici_total_s, ici_bw_s, dt, seq)

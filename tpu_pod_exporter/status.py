@@ -134,7 +134,7 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
         if owner:
             agg = pods.setdefault((owner.namespace, owner.pod), [0, 0.0])
             agg[0] += 1
-            agg[1] += chip.hbm_used_bytes
+            agg[1] += chip.hbm_used_bytes or 0.0
         if as_json:
             chip_holders = holders_by_path.get(chip.info.device_path, [])
             doc_chips.append({
@@ -162,13 +162,18 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
         )
         pct = (
             f"{100 * chip.hbm_used_bytes / chip.hbm_total_bytes:.1f}%"
-            if chip.hbm_total_bytes
+            if chip.hbm_total_bytes and chip.hbm_used_bytes is not None
             else "-"
+        )
+        hbm_cell = (
+            f"{fmt_bytes(chip.hbm_used_bytes)}/{fmt_bytes(chip.hbm_total_bytes)}"
+            if chip.hbm_used_bytes is not None and chip.hbm_total_bytes is not None
+            else "-"  # backend couldn't read HBM (e.g. tunnel, HARDWARE.md)
         )
         row = [
             chip.info.chip_id,
             chip.info.device_path or "-",
-            f"{fmt_bytes(chip.hbm_used_bytes)}/{fmt_bytes(chip.hbm_total_bytes)}",
+            hbm_cell,
             pct,
             duty,
             f"{owner.namespace}/{owner.pod}" if owner else "-",
