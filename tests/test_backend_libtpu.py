@@ -22,12 +22,34 @@ def metric_response(rows):
     resp = pb.MetricResponse()
     for dev, value in rows:
         m = resp.metric.metrics.add()
-        m.attribute.key = "device-id"
-        m.attribute.value.int_attr = dev
+        a = m.attribute.add()
+        a.key = "device-id"
+        a.value.int_attr = dev
         if isinstance(value, int):
             m.gauge.as_int = value
         else:
             m.gauge.as_double = value
+    return resp
+
+
+def link_response(rows, device_key="device-id", link_key="link-id",
+                  link_first=False):
+    """rows: [(device_id:int, link_id:int|str, value:int)] — two-attribute
+    per-link rows, in either attribute order."""
+    resp = pb.MetricResponse()
+    for dev, link, value in rows:
+        m = resp.metric.metrics.add()
+        attrs = []
+        d = pb.Attribute(key=device_key)
+        d.value.int_attr = dev
+        l = pb.Attribute(key=link_key)
+        if isinstance(link, int):
+            l.value.int_attr = link
+        else:
+            l.value.string_attr = link
+        attrs = [l, d] if link_first else [d, l]
+        m.attribute.extend(attrs)
+        m.gauge.as_int = value
     return resp
 
 
@@ -207,8 +229,9 @@ class TestLibtpuBackend:
         resp = pb.MetricResponse()
         for dev in ("1", "x"):
             m = resp.metric.metrics.add()
-            m.attribute.key = "device-id"
-            m.attribute.value.string_attr = dev
+            a = m.attribute.add()
+            a.key = "device-id"
+            a.value.string_attr = dev
             m.gauge.as_int = GIB
         service.tables[HBM_USAGE] = resp
         service.tables[HBM_TOTAL] = resp
@@ -223,8 +246,9 @@ class TestLibtpuBackend:
         service, addr = metric_server
         resp = pb.MetricResponse()
         m = resp.metric.metrics.add()
-        m.attribute.key = "device-id"
-        m.attribute.value.string_attr = "7"
+        a = m.attribute.add()
+        a.key = "device-id"
+        a.value.string_attr = "7"
         m.gauge.as_int = 5 * GIB
         service.tables[HBM_USAGE] = resp
         service.set(HBM_TOTAL, [(7, 32 * GIB)])
@@ -294,12 +318,12 @@ class TestIciDiscovery:
     def test_confirmed_name_vanishing_triggers_rediscovery(self, metric_server):
         service, addr = metric_server
         self._base(service)
-        service.supported = [ICI_TRANSFERRED]
+        service.supported = [HBM_USAGE, HBM_TOTAL, DUTY_CYCLE, ICI_TRANSFERRED]
         service.set(ICI_TRANSFERRED, [(0, 5)])
         backend = LibtpuMetricsBackend(addr=addr, device_paths={})
         assert backend.sample().chips[0].ici_links
         del service.tables[ICI_TRANSFERRED]  # runtime swap: now NOT_FOUND
-        service.supported = []
+        service.supported = [HBM_USAGE, HBM_TOTAL, DUTY_CYCLE]
         assert backend.sample().chips[0].ici_links == ()
         backend.sample()
         assert service.list_calls == 2  # re-discovered once, then latched off
@@ -312,7 +336,9 @@ class TestIciDiscovery:
         # rediscover/fail loop.
         service, addr = metric_server
         self._base(service)
-        service.supported = [ICI_TRANSFERRED]  # listed but never served
+        # Listed (alongside the really-served base metrics, so enumeration
+        # passes the round-4 sanity check) but never served:
+        service.supported = [HBM_USAGE, HBM_TOTAL, DUTY_CYCLE, ICI_TRANSFERRED]
         backend = LibtpuMetricsBackend(addr=addr, device_paths={})
         backend.sample()  # confirm -> query NOT_FOUND -> vanish
         backend.sample()  # rediscover without the vanished name -> latch off
@@ -394,12 +420,14 @@ class TestProbeTool:
         service, addr = metric_server
         resp = pb.MetricResponse()
         m = resp.metric.metrics.add()
-        m.attribute.key = "device-id"
-        m.attribute.value.int_attr = 0
+        a = m.attribute.add()
+        a.key = "device-id"
+        a.value.int_attr = 0
         m.gauge.as_string = "v5e"
         n = resp.metric.metrics.add()
-        n.attribute.key = "device-id"
-        n.attribute.value.int_attr = 1  # gauge left unset
+        b = n.attribute.add()
+        b.key = "device-id"
+        b.value.int_attr = 1  # gauge left unset
         service.tables["chip.kind"] = resp
         service.supported = ["chip.kind"]
         report = probe(addr, timeout_s=2.0)
@@ -408,3 +436,148 @@ class TestProbeTool:
         samples = doc["metrics"]["chip.kind"]["sample"]
         assert samples[0]["value"] == "v5e"
         assert samples[1]["value"] is None
+
+
+class TestPerLinkIci:
+    """Per-link ICI through the production proto path (BASELINE config 4's
+    headline; VERDICT r3 #3): two-attribute rows in either order become real
+    `link` labels; single-attribute rows keep the degraded link="all"."""
+
+    def _base(self, service):
+        service.set(HBM_USAGE, [(0, GIB), (1, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB), (1, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0), (1, 1.0)])
+
+    ROWS = [(0, 0, 100), (0, 1, 200), (1, 0, 300), (1, 1, 400)]
+
+    @pytest.mark.parametrize("link_first", [False, True])
+    def test_two_attribute_rows_either_order(self, metric_server, link_first):
+        service, addr = metric_server
+        self._base(service)
+        service.tables[ICI_TRANSFERRED] = link_response(
+            self.ROWS, link_first=link_first
+        )
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        c0, c1 = sample.chips
+        assert [(l.link, l.transferred_bytes_total) for l in c0.ici_links] == [
+            ("0", 100.0), ("1", 200.0)
+        ]
+        assert [(l.link, l.transferred_bytes_total) for l in c1.ici_links] == [
+            ("0", 300.0), ("1", 400.0)
+        ]
+        backend.close()
+
+    def test_unrecognized_keys_fall_back_positionally(self, metric_server):
+        service, addr = metric_server
+        self._base(service)
+        # Keys matching no hint: first attribute is the device, second the
+        # link — the only sane default for an unknown runtime vocabulary.
+        service.tables[ICI_TRANSFERRED] = link_response(
+            [(0, 3, 50)], device_key="idx", link_key="lane"
+        )
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        (c0, _c1) = backend.sample().chips
+        assert [(l.link, l.transferred_bytes_total) for l in c0.ici_links] == [
+            ("3", 50.0)
+        ]
+        backend.close()
+
+    def test_string_link_ids(self, metric_server):
+        service, addr = metric_server
+        self._base(service)
+        service.tables[ICI_TRANSFERRED] = link_response(
+            [(0, "x+", 10), (0, "x-", 20)]
+        )
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        (c0, _c1) = backend.sample().chips
+        assert {l.link for l in c0.ici_links} == {"x+", "x-"}
+        backend.close()
+
+    def test_end_to_end_link_labels_in_bandwidth_series(self, metric_server):
+        """Fake gRPC server → libtpu backend → collector → per-link
+        tpu_ici_link_bandwidth_bytes_per_second{link="..."}."""
+        from tpu_pod_exporter.attribution.fake import FakeAttribution
+        from tpu_pod_exporter.collector import Collector
+        from tpu_pod_exporter.metrics import SnapshotStore
+        from tpu_pod_exporter.topology import HostTopology
+
+        service, addr = metric_server
+        self._base(service)
+        service.tables[ICI_TRANSFERRED] = link_response(self.ROWS)
+        backend = LibtpuMetricsBackend(
+            addr=addr, device_paths={0: "/dev/accel0", 1: "/dev/accel1"}
+        )
+        store = SnapshotStore()
+        fake_now = [0.0]
+        c = Collector(
+            backend,
+            FakeAttribution(),
+            store,
+            topology=HostTopology(
+                accelerator="v5e-8", slice_name="s0", host="h0", worker_id="0"
+            ),
+            clock=lambda: fake_now[0],
+        )
+        c.poll_once()
+        # Advance counters and the clock: 2 s, +200 bytes on dev0 link1.
+        service.tables[ICI_TRANSFERRED] = link_response(
+            [(0, 0, 100), (0, 1, 400), (1, 0, 300), (1, 1, 400)]
+        )
+        fake_now[0] += 2.0
+        c.poll_once()
+        snap = store.current()
+        labels = {
+            "chip_id": "0", "device_path": "/dev/accel0",
+            "accelerator": "v5e-8", "slice_name": "s0", "host": "h0",
+            "worker_id": "0", "pod": "", "namespace": "", "container": "",
+            "link": "1",
+        }
+        assert snap.value("tpu_ici_transferred_bytes_total", labels) == 400.0
+        assert snap.value("tpu_ici_link_bandwidth_bytes_per_second", labels) == 100.0
+        # The degraded link="all" shape is NOT emitted when real links exist.
+        assert snap.value(
+            "tpu_ici_transferred_bytes_total", {**labels, "link": "all"}
+        ) is None
+        backend.close()
+
+
+class TestEnumerationSanityCheck:
+    """ADVICE r2 #1: a wire-shape-mismatched ListSupportedMetrics parses as
+    an empty/garbled list; trusting it would silently latch ICI off. The
+    check: HBM_USAGE was served seconds ago, so any enumeration omitting it
+    is unreliable and discovery must fall through to direct probes."""
+
+    def _base(self, service):
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+
+    def test_empty_enumeration_falls_through_to_probe(self, metric_server):
+        service, addr = metric_server
+        self._base(service)
+        service.supported = []  # mismatched schema parses to nothing
+        service.set(ICI_TRANSFERRED, [(0, 123)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        # ICI survived: probe path found the metric enumeration "denied".
+        assert sample.chips[0].ici_links[0].transferred_bytes_total == 123
+        backend.close()
+
+    def test_garbled_enumeration_falls_through_to_probe(self, metric_server):
+        service, addr = metric_server
+        self._base(service)
+        service.supported = ["unrelated.metric.name"]  # omits HBM_USAGE
+        service.set(ICI_TRANSFERRED, [(0, 7)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        assert backend.sample().chips[0].ici_links[0].transferred_bytes_total == 7
+        backend.close()
+
+    def test_trusted_enumeration_still_avoids_blind_probes(self, metric_server):
+        service, addr = metric_server
+        self._base(service)
+        service.supported = [HBM_USAGE, HBM_TOTAL, DUTY_CYCLE]
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        backend.sample()
+        assert ICI_TRANSFERRED not in service.calls  # enumeration trusted
+        backend.close()

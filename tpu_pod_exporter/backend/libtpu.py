@@ -13,7 +13,10 @@ Metric names queried (the public libtpu names):
   - ``tpu.runtime.tensorcore.dutycycle.percent`` (per chip)
 
 All three are fetched in one poll; each response row carries a device-id
-attribute. Any RPC failure, parse surprise, or shape mismatch raises
+attribute, and ICI counter rows may additionally carry a link attribute
+(either attribute order) which becomes the per-link ``link`` label —
+the degraded single-attribute shape exports ``link="all"``.
+Any RPC failure, parse surprise, or shape mismatch raises
 BackendError (total) or is reported via ``HostSample.partial_errors``
 (per-metric) — the collector degrades instead of dying (contrast the
 reference's ``log.Fatalf`` per query, ``main.go:119-137``).
@@ -73,20 +76,76 @@ def gauge_value(metric) -> float:
     return float("nan")
 
 
-def attr_id(metric) -> str:
-    which = metric.attribute.value.WhichOneof("attr")
+def attr_str(value) -> str:
+    which = value.WhichOneof("attr")
     if which == "int_attr":
-        return str(metric.attribute.value.int_attr)
+        return str(value.int_attr)
     if which == "string_attr":
-        return metric.attribute.value.string_attr
+        return value.string_attr
     return ""
 
 
+# Attribute-key substrings that identify which attribute on a metric row is
+# the device id vs the ICI link id. Matched case-insensitively so both
+# "device-id" and "DeviceId" shapes resolve; per-link rows may carry the two
+# attributes in either order.
+DEVICE_ATTR_HINTS = ("device", "chip", "core", "accel")
+LINK_ATTR_HINTS = ("link", "port", "direction", "neighbor", "axis")
+
+
+def split_attrs(metric) -> tuple[str, str | None]:
+    """One metric row's attributes → (device_id, link_id-or-None).
+
+    Historical rows carry exactly one attribute (the device id). Per-link
+    ICI counters (BASELINE config 4's headline) carry a device attribute
+    plus a link attribute — accepted in either order by matching attribute
+    *keys*, with a positional fallback (first=device, second=link) for a
+    runtime whose key names match no hint. Contrast the reference, which
+    only ever walks one implicit device axis (main.go:123-138).
+    """
+    attrs = metric.attribute
+    if len(attrs) == 1:
+        return attr_str(attrs[0].value), None
+    if not attrs:
+        return "", None
+    dev: str | None = None
+    link: str | None = None
+    rest = []
+    for a in attrs:
+        k = a.key.lower()
+        if dev is None and any(h in k for h in DEVICE_ATTR_HINTS):
+            dev = attr_str(a.value)
+        elif link is None and any(h in k for h in LINK_ATTR_HINTS):
+            link = attr_str(a.value)
+        else:
+            rest.append(a)
+    if dev is None and rest:
+        dev = attr_str(rest.pop(0).value)
+    if link is None and rest:
+        link = attr_str(rest[0].value)
+    return dev or "", link
+
+
 def rows_by_device(resp) -> dict[str, float]:
-    """MetricResponse → {device_id_attr: value}."""
+    """MetricResponse → {device_id_attr: value} (per-device metrics)."""
     out: dict[str, float] = {}
     for m in resp.metric.metrics:
-        out[attr_id(m)] = gauge_value(m)
+        dev, _ = split_attrs(m)
+        out[dev] = gauge_value(m)
+    return out
+
+
+def ici_rows(resp) -> dict[str, dict[str, float]]:
+    """MetricResponse → {device_id: {link_id: value}}.
+
+    Rows without a link attribute land under link "all" — the degraded
+    per-chip-aggregate shape older runtimes serve (and the only shape the
+    production path could emit before round 4).
+    """
+    out: dict[str, dict[str, float]] = {}
+    for m in resp.metric.metrics:
+        dev, link = split_attrs(m)
+        out.setdefault(dev, {})[link if link is not None else "all"] = gauge_value(m)
     return out
 
 
@@ -170,6 +229,9 @@ class LibtpuMetricsBackend(DeviceBackend):
     def _query(self, metric_name: str) -> dict[str, float]:
         return rows_by_device(self.query_raw(metric_name))
 
+    def _query_ici(self, metric_name: str) -> dict[str, dict[str, float]]:
+        return ici_rows(self.query_raw(metric_name))
+
     def list_supported_metrics(self) -> list[str] | None:
         """Names the runtime serves, or None when the runtime does not
         implement the enumeration RPC (older libtpu)."""
@@ -187,7 +249,7 @@ class LibtpuMetricsBackend(DeviceBackend):
             raise
         return [m.metric_name for m in resp.supported_metric]
 
-    def _resolve_ici_metric(self) -> dict[str, float] | None:
+    def _resolve_ici_metric(self) -> dict[str, dict[str, float]] | None:
         """One-time discovery of the ICI counter's real name. Sets
         ``self._ici_metric`` to the confirmed name, or False when the
         runtime affirmatively serves none of the candidates. Returns the
@@ -197,6 +259,19 @@ class LibtpuMetricsBackend(DeviceBackend):
         Names in ``self._ici_vanished`` are excluded — see __init__."""
         candidates = [n for n in ICI_CANDIDATES if n not in self._ici_vanished]
         supported = self.list_supported_metrics()
+        if supported is not None and HBM_USAGE not in supported:
+            # Sanity check before trusting enumeration: sample() queried
+            # HBM_USAGE successfully moments ago, so a list omitting it
+            # means the RPC exists but its wire shape differs from our
+            # guessed proto (proto3 parses a mismatched response as empty,
+            # not as an error). Trusting it would silently latch ICI off on
+            # a runtime that serves it — fall through to direct probes.
+            log.warning(
+                "ListSupportedMetrics omitted %s (just served); treating "
+                "enumeration as unreliable and probing candidates directly",
+                HBM_USAGE,
+            )
+            supported = None
         if supported is not None:
             for name in candidates:
                 if name in supported:
@@ -216,7 +291,7 @@ class LibtpuMetricsBackend(DeviceBackend):
         # No enumeration RPC: probe candidates directly.
         for name in candidates:
             try:
-                rows = self._query(name)
+                rows = self._query_ici(name)
                 self._ici_metric = name
                 log.info("ICI counter confirmed by probe: %s", name)
                 return rows
@@ -250,8 +325,8 @@ class LibtpuMetricsBackend(DeviceBackend):
             duty = {}
             partial.append(f"duty-cycle query failed: {e}")
 
-        ici: dict[str, float] = {}
-        discovered_rows: dict[str, float] | None = None
+        ici: dict[str, dict[str, float]] = {}
+        discovered_rows: dict[str, dict[str, float]] | None = None
         if self._ici_metric is None:
             try:
                 discovered_rows = self._resolve_ici_metric()
@@ -262,7 +337,7 @@ class LibtpuMetricsBackend(DeviceBackend):
                 ici = discovered_rows  # probe already fetched this poll's rows
             else:
                 try:
-                    ici = self._query(self._ici_metric)
+                    ici = self._query_ici(self._ici_metric)
                 except Exception as e:  # noqa: BLE001
                     code = getattr(e, "code", lambda: None)()
                     if code in (
@@ -296,9 +371,13 @@ class LibtpuMetricsBackend(DeviceBackend):
             idx = int(dev_id) if all_numeric else pos
             links = ()
             if dev_id in ici:
-                # Single aggregate counter per chip when per-link detail is
-                # unavailable; labeled link="all".
-                links = (IciLinkSample(link="all", transferred_bytes_total=ici[dev_id]),)
+                # Per-link rows when the runtime serves a link attribute
+                # (link id order stabilized for the collector's layout
+                # fast-path); a single aggregate row degrades to link="all".
+                links = tuple(
+                    IciLinkSample(link=lk, transferred_bytes_total=v)
+                    for lk, v in sorted(ici[dev_id].items(), key=_link_sort_key)
+                )
             chips.append(
                 ChipSample(
                     info=ChipInfo(
@@ -334,3 +413,7 @@ def _dev_sort_key(dev_id: str):
         return (0, int(dev_id))
     except ValueError:
         return (1, dev_id)
+
+
+def _link_sort_key(item: tuple[str, float]):
+    return _dev_sort_key(item[0])

@@ -34,7 +34,7 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
         HBM_USAGE,
         ICI_CANDIDATES,
         LibtpuMetricsBackend,
-        attr_id,
+        split_attrs,
     )
 
     def raw_gauge(m):
@@ -49,6 +49,15 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
         if which == "as_string":
             return m.gauge.as_string
         return None
+
+    def sample_row(m):
+        # split_attrs handles both one-attribute (device only) and
+        # per-link two-attribute rows; link key omitted when absent.
+        dev, link = split_attrs(m)
+        row = {"attr": dev, "value": raw_gauge(m)}
+        if link is not None:
+            row["link"] = link
+        return row
 
     backend = LibtpuMetricsBackend(addr=addr, timeout_s=timeout_s, device_paths={})
     report: dict = {
@@ -90,14 +99,11 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
             rows = resp.metric.metrics
             report["metrics"][name] = {
                 "rows": len(rows),
-                "attr_keys": sorted({m.attribute.key for m in rows}),
+                "attr_keys": sorted({a.key for m in rows for a in m.attribute}),
                 "gauge_types": sorted(
                     {m.gauge.WhichOneof("value") or "none" for m in rows}
                 ),
-                "sample": [
-                    {"attr": attr_id(m), "value": raw_gauge(m)}
-                    for m in rows[:max_rows]
-                ],
+                "sample": [sample_row(m) for m in rows[:max_rows]],
             }
     finally:
         backend.close()
