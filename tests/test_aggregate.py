@@ -368,3 +368,36 @@ class TestParserRobustness:
         SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages)).poll_once()
         dur = store.current().value("tpu_aggregator_round_duration_seconds", {})
         assert dur is not None and 0.0 <= dur < 60.0
+
+
+class TestParseCacheConcurrency:
+    def test_concurrent_parsers_keep_accounting_consistent(self, monkeypatch):
+        """ADVICE r2 #4: the block cache is shared across threads; clears
+        racing inserts must not let the byte accounting drift from actual
+        residency (a drift would quietly disable or unbound the budget)."""
+        import threading
+
+        from tpu_pod_exporter.metrics import parse as parse_mod
+
+        monkeypatch.setattr(parse_mod, "_BLOCK_CACHE", {})
+        parse_mod._block_cache_bytes = 0
+        # Budget small enough that every thread forces clears continuously.
+        monkeypatch.setattr(parse_mod, "_BLOCK_CACHE_MAX_BYTES", 4000)
+
+        def worker(tid):
+            for i in range(300):
+                text = f'm{{t="{tid}",i="{i}"}} 1\n'
+                list(parse_mod.parse_exposition(text))
+
+        threads = [threading.Thread(target=worker, args=(t,)) for t in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        with parse_mod._block_cache_lock:
+            actual = sum(
+                parse_mod._entry_cost(k) for k in parse_mod._BLOCK_CACHE
+            )
+            assert parse_mod._block_cache_bytes == actual
+        parse_mod._BLOCK_CACHE.clear()
+        parse_mod._block_cache_bytes = 0

@@ -370,6 +370,69 @@ class TestUidMap:
         assert src.mapping()["u"] == ("p", "n")  # last-good served
         assert src.fetch_errors == 1
 
+    def test_bearer_token_over_unverified_https_refused(self, tmp_path):
+        """ADVICE r2 #2: an explicit token + https + no CA must refuse at
+        startup, not quietly ship the credential over unverified TLS."""
+        from tpu_pod_exporter.attribution.uidmap import (
+            KubeletPodsUidMap,
+            UidMapError,
+        )
+
+        token = tmp_path / "token"
+        token.write_text("secret")
+        with pytest.raises(UidMapError, match="unverified TLS"):
+            KubeletPodsUidMap(
+                "https://127.0.0.1:10250/pods", token_file=str(token)
+            )
+
+    def test_bearer_token_unverified_https_explicit_opt_in(self, tmp_path):
+        from tpu_pod_exporter.attribution.uidmap import KubeletPodsUidMap
+
+        token = tmp_path / "token"
+        token.write_text("secret")
+        src = KubeletPodsUidMap(
+            "https://127.0.0.1:10250/pods",
+            token_file=str(token),
+            insecure_tls=True,
+            _fetch=lambda url, headers, t: b'{"items": []}',
+        )
+        assert src.mapping() == {}
+
+    def test_token_with_ca_or_plain_http_is_fine(self, tmp_path):
+        from tpu_pod_exporter.attribution.uidmap import KubeletPodsUidMap
+
+        token = tmp_path / "token"
+        token.write_text("secret")
+        ca = tmp_path / "ca.crt"
+        ca.write_text("---")
+        KubeletPodsUidMap(
+            "https://127.0.0.1:10250/pods",
+            token_file=str(token), ca_file=str(ca),
+        )
+        KubeletPodsUidMap("http://127.0.0.1:10255/pods", token_file=str(token))
+
+    def test_app_does_not_auto_default_token_without_ca(self, tmp_path, monkeypatch):
+        """The auto path drops the token (with a warning) rather than
+        leaking it, when the SA CA bundle is absent."""
+        import tpu_pod_exporter.app as app_mod
+        from tpu_pod_exporter.app import _build_uid_source
+        from tpu_pod_exporter.config import ExporterConfig
+
+        token = tmp_path / "token"
+        token.write_text("secret")
+        monkeypatch.setattr(
+            "tpu_pod_exporter.attribution.uidmap.DEFAULT_TOKEN_FILE",
+            str(token), raising=False,
+        )
+        monkeypatch.setattr(
+            "tpu_pod_exporter.attribution.uidmap.DEFAULT_CA_FILE",
+            str(tmp_path / "absent-ca.crt"), raising=False,
+        )
+        cfg = ExporterConfig(kubelet_pods_url="https://127.0.0.1:10250/pods")
+        src = _build_uid_source(cfg)
+        assert src is not None
+        assert src._token_file is None  # token NOT auto-sent unverified
+
     def test_checkpoint_provider_uses_live_source(self, tmp_path):
         from tpu_pod_exporter.attribution.uidmap import StaticUidMap
 
@@ -396,7 +459,7 @@ class TestUidMap:
 
     def test_uid_map_errors_reach_exporter_metrics(self, tmp_path):
         """Source failures must surface as
-        tpu_exporter_poll_errors_total{source="uid_map"}, not just a log."""
+        tpu_exporter_poll_errors_total{source="attribution.uid_map"}, not just a log."""
         from tpu_pod_exporter.attribution.uidmap import StaticUidMap
         from tpu_pod_exporter.backend.fake import FakeBackend
         from tpu_pod_exporter.collector import Collector
@@ -412,5 +475,5 @@ class TestUidMap:
         c.poll_once()
         c.poll_once()
         assert store.current().value(
-            "tpu_exporter_poll_errors_total", {"source": "uid_map"}
+            "tpu_exporter_poll_errors_total", {"source": "attribution.uid_map"}
         ) == 2.0

@@ -433,3 +433,29 @@ class TestTelemetryDepth:
         assert chip.hbm_peak_bytes == 7.0
         assert chip.info.device_kind == "TPU v4"
         assert chip.info.coords == "0,1,2"
+
+
+class TestSideChannelErrorNamespacing:
+    def test_provider_source_names_cannot_clobber_phase_counters(self, store):
+        """ADVICE r2 #3: side-channel error counters are published with
+        b.add (overwrite); a provider returning a source named like a poll
+        phase ("attribution") must not replace the phase series."""
+
+        class CollidingAttribution(FakeAttribution):
+            def error_counters(self):
+                return {"attribution": 99.0}
+
+        backend = FakeBackend(chips=1)
+        attr = CollidingAttribution()
+        attr.fail_next(1)  # one real attribution-phase error
+        c = make_collector(backend, attr, store)
+        c.poll_once()
+        snap = store.current()
+        # The phase counter survives with its own count...
+        assert snap.value(
+            "tpu_exporter_poll_errors_total", {"source": "attribution"}
+        ) == 1.0
+        # ...and the provider's counter appears under its namespaced name.
+        assert snap.value(
+            "tpu_exporter_poll_errors_total", {"source": "attribution.attribution"}
+        ) == 99.0

@@ -129,18 +129,30 @@ def _build_uid_source(cfg: ExporterConfig):
         token_file = cfg.kubelet_token_file
         ca_file = cfg.kubelet_ca_file
         if cfg.kubelet_pods_url.startswith("https:"):
-            # Auto-default BOTH in-pod SA mounts together: defaulting the
-            # bearer token without the CA bundle would send a real cluster
-            # credential over unverified TLS.
-            if not token_file and os.path.exists(DEFAULT_TOKEN_FILE):
-                token_file = DEFAULT_TOKEN_FILE
             if not ca_file and os.path.exists(DEFAULT_CA_FILE):
                 ca_file = DEFAULT_CA_FILE
+            # Auto-default the bearer token ONLY when TLS will actually be
+            # verified (CA resolved, or the operator explicitly opted out):
+            # a token over unverified TLS is a leaked cluster credential.
+            # Explicitly-configured tokens are policed by KubeletPodsUidMap
+            # itself, which refuses the combination at startup.
+            if not token_file and os.path.exists(DEFAULT_TOKEN_FILE):
+                if ca_file or cfg.kubelet_insecure_tls:
+                    token_file = DEFAULT_TOKEN_FILE
+                else:
+                    log.warning(
+                        "service-account token present but no CA bundle at "
+                        "%s; fetching %s WITHOUT auth rather than sending "
+                        "the token over unverified TLS (set "
+                        "--kubelet-ca-file or --kubelet-insecure-tls)",
+                        DEFAULT_CA_FILE, cfg.kubelet_pods_url,
+                    )
         return KubeletPodsUidMap(
             cfg.kubelet_pods_url,
             token_file=token_file or None,
             ca_file=ca_file or None,
             refresh_s=cfg.kubelet_pods_refresh_s,
+            insecure_tls=cfg.kubelet_insecure_tls,
         )
     return None
 

@@ -103,7 +103,7 @@ class CheckpointAttribution(AttributionProvider):
 
     def error_counters(self) -> dict[str, float]:
         """Cumulative side-channel error counts, published by the collector
-        as ``tpu_exporter_poll_errors_total{source="uid_map"}`` — covers
+        as ``tpu_exporter_poll_errors_total{source="attribution.uid_map"}`` — covers
         both resolver exceptions seen here and the kubelet source's
         internal fetch failures (which degrade to last-good silently)."""
         total = self._uid_map_errors + int(

@@ -102,9 +102,28 @@ class KubeletPodsUidMap:
         ca_file: str | None = None,
         refresh_s: float = 30.0,
         timeout_s: float = 5.0,
+        insecure_tls: bool = False,
         _fetch=None,  # test seam: (url, headers, timeout_s) -> bytes
         _clock=time.monotonic,
     ) -> None:
+        if url.startswith("https:") and token_file and not ca_file:
+            # A bearer token is a real cluster credential; sending it over
+            # an unverified TLS channel hands it to any MITM. Refuse at
+            # construction (fail loud at startup, not quietly at runtime)
+            # unless the operator explicitly accepted the risk.
+            if not insecure_tls:
+                raise UidMapError(
+                    "kubelet_token_file is set for an https kubelet URL but "
+                    "kubelet_ca_file is not: refusing to send a bearer token "
+                    "over unverified TLS. Set --kubelet-ca-file (the SA "
+                    "mount's ca.crt) or explicitly opt in with "
+                    "--kubelet-insecure-tls."
+                )
+            log.warning(
+                "sending the kubelet bearer token over UNVERIFIED TLS "
+                "(--kubelet-insecure-tls): acceptable only when %s "
+                "never leaves this node", url,
+            )
         self._url = url
         self._token_file = token_file
         self._ca_file = ca_file
@@ -115,7 +134,7 @@ class KubeletPodsUidMap:
         self._map: dict[str, tuple[str, str]] = {}
         self._fetched_at: float | None = None
         # Cumulative; surfaced by CheckpointAttribution.error_counters() as
-        # tpu_exporter_poll_errors_total{source="uid_map"}.
+        # tpu_exporter_poll_errors_total{source="attribution.uid_map"}.
         self.fetch_errors = 0
 
     def _http_fetch(self, url: str, headers: dict, timeout_s: float) -> bytes:

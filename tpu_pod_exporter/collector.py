@@ -466,7 +466,14 @@ class Collector:
         attr_errors = getattr(self._attribution, "error_counters", None)
         if callable(attr_errors):
             for source, v in attr_errors().items():
-                b.add(schema.TPU_EXPORTER_POLL_ERRORS_TOTAL, float(v), (source,))
+                # Namespaced: a provider-chosen source name must never
+                # collide with (b.add overwrites, not sums) a poll-phase
+                # counter series like source="attribution".
+                b.add(
+                    schema.TPU_EXPORTER_POLL_ERRORS_TOTAL,
+                    float(v),
+                    (f"attribution.{source}",),
+                )
         polls = self._counters.inc(schema.TPU_EXPORTER_POLLS_TOTAL.name, ())
         b.add(schema.TPU_EXPORTER_POLLS_TOTAL, polls)
         b.add(

@@ -34,6 +34,9 @@ class ExporterConfig:
     kubelet_pods_url: str = ""     # e.g. https://127.0.0.1:10250/pods
     kubelet_token_file: str = ""   # bearer token (default SA token if https)
     kubelet_ca_file: str = ""      # CA bundle; unset = skip verify (node-local)
+    # Explicit opt-in to sending the bearer token over UNVERIFIED https —
+    # without it, token+https+no-CA refuses at startup (credential safety).
+    kubelet_insecure_tls: bool = False
     kubelet_pods_refresh_s: float = 30.0
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0

@@ -11,6 +11,7 @@ break when a newer exporter adds metrics.
 from __future__ import annotations
 
 import re
+import threading
 from typing import Iterator, NamedTuple
 
 
@@ -70,6 +71,13 @@ _BLOCK_CACHE: dict[str, dict[str, str]] = {}
 _BLOCK_CACHE_MAX_BYTES = 32 << 20  # approximate *resident* bytes
 _BLOCK_CACHE_MAX_ENTRY = 1 << 10
 _block_cache_bytes = 0
+# The cache is module-global shared mutable state; parsers can run from
+# multiple threads (aggregator publish thread today, potentially a scrape
+# pool tomorrow), so clear()/byte-accounting mutations are guarded. The
+# lock is only taken on cache MISS — the hit path (steady state) stays a
+# lock-free dict read, safe under the GIL because entries are immutable
+# once inserted.
+_block_cache_lock = threading.Lock()
 
 
 def _entry_cost(block: str) -> int:
@@ -88,11 +96,13 @@ def _parse_label_block(block: str, line: str) -> dict[str, str]:
     if cached is None:
         cached = _parse_block_uncached(block, line)
         if len(block) <= _BLOCK_CACHE_MAX_ENTRY:
-            if _block_cache_bytes >= _BLOCK_CACHE_MAX_BYTES:
-                _BLOCK_CACHE.clear()
-                _block_cache_bytes = 0
-            _BLOCK_CACHE[block] = cached
-            _block_cache_bytes += _entry_cost(block)
+            with _block_cache_lock:
+                if _block_cache_bytes >= _BLOCK_CACHE_MAX_BYTES:
+                    _BLOCK_CACHE.clear()
+                    _block_cache_bytes = 0
+                if block not in _BLOCK_CACHE:  # a racing miss already paid
+                    _BLOCK_CACHE[block] = cached
+                    _block_cache_bytes += _entry_cost(block)
     # Copy: callers own their labels dict (ParsedSample is public API).
     return dict(cached)
 
