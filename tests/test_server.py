@@ -266,3 +266,98 @@ class TestAcceptParsing:
         assert acc("APPLICATION/OpenMetrics-Text") is True
         # malformed q counts as accepting (q defaults to 1)
         assert acc("application/openmetrics-text;q=abc") is True
+
+
+class TestScrapeConcurrencyGuard:
+    """VERDICT r3 #8: a scrape storm must hit a 429 wall, not eat a core.
+    At most N /metrics handlers run at once; the N+1th queues briefly and
+    is rejected with Retry-After."""
+
+    def _blocking_store(self, release, entered):
+        """A store whose snapshots block inside encode() until released —
+        holds handler threads inside the guarded section deterministically."""
+        import threading
+
+        store = SnapshotStore()
+        put_snapshot(store, 7)
+        real = store.current()
+
+        class BlockingSnapshot:
+            timestamp = real.timestamp
+            series_count = real.series_count
+
+            @staticmethod
+            def encode():
+                entered.release()
+                release.acquire()
+                return real.encode()
+
+            encode_openmetrics = encode
+            encode_gzip = encode
+            encode_openmetrics_gzip = encode
+
+        class BlockingStore:
+            @staticmethod
+            def current():
+                return BlockingSnapshot
+
+        return BlockingStore()
+
+    def test_excess_scrapes_get_429(self):
+        import threading
+        import urllib.error
+
+        release = threading.Semaphore(0)
+        entered = threading.Semaphore(0)
+        store = self._blocking_store(release, entered)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0,
+            max_concurrent_scrapes=2, scrape_queue_timeout_s=0.1,
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        results = []
+
+        def scrape():
+            results.append(get(base + "/metrics")[0])
+
+        try:
+            holders = [threading.Thread(target=scrape) for _ in range(2)]
+            for t in holders:
+                t.start()
+            # Wait until both holders are INSIDE the guarded render.
+            for _ in range(2):
+                assert entered.acquire(timeout=5)
+            # Slots are full: the next scrape must be rejected after the
+            # queue timeout...
+            status, headers, body = get(base + "/metrics")
+            assert status == 429
+            assert headers["Retry-After"] == "1"
+            assert b"too many" in body
+            # ...while non-scrape endpoints stay unguarded.
+            assert get(base + "/healthz")[0] == 200
+            assert server.scrape_rejects[0] == 1
+            # Release the holders: both complete fine.
+            release.release(2)
+            for t in holders:
+                t.join(timeout=5)
+            assert results == [200, 200]
+            # And the slots are free again.
+            entered.release(2)  # encode() no longer needs to signal
+            release.release(2)
+            assert get(base + "/metrics")[0] == 200
+        finally:
+            release.release(8)
+            server.stop()
+
+    def test_guard_disabled_with_zero(self):
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, max_concurrent_scrapes=0
+        )
+        server.start()
+        try:
+            assert get(f"http://127.0.0.1:{server.port}/metrics")[0] == 200
+        finally:
+            server.stop()

@@ -213,6 +213,7 @@ class ExporterApp:
             port=cfg.port,
             debug_vars=self._debug_vars,
             health_max_age_s=max(10.0 * cfg.interval_s, 10.0),
+            max_concurrent_scrapes=cfg.max_concurrent_scrapes,
         )
 
     def _debug_vars(self) -> dict:
@@ -240,6 +241,7 @@ class ExporterApp:
             "loop_overruns": self.loop.overruns,
             "series": snap.series_count,
             "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
+            "scrape_rejects": self.server.scrape_rejects[0],
         }
         if self.process_scanner is not None:
             out["process_scanner"] = {

@@ -40,6 +40,9 @@ class ExporterConfig:
     kubelet_pods_refresh_s: float = 30.0
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0
+    # /metrics concurrency cap: excess scrapers queue briefly then get 429
+    # (0 disables). Protects the TPU host's cores from scrape storms.
+    max_concurrent_scrapes: int = 4
     process_metrics: bool = False  # procfs scan: which host pids hold which chips
     proc_root: str = "/proc"       # injectable for tests / sidecar mounts
     process_full_scan_every: int = 10  # polls between full /proc walks

@@ -73,6 +73,14 @@ class _Handler(BaseHTTPRequestHandler):
     # snapshots; liveness must catch that so kubelet restarts the pod —
     # serving stale bytes forever would look "up" while monitoring nothing.
     health_max_age_s: float = 0.0
+    # Concurrency guard for /metrics: at most N handlers render/send at
+    # once; excess requests queue briefly, then get 429 + Retry-After. A
+    # misconfigured scrape storm (BENCH: ~1k scrapes/s ate half a core)
+    # must not be able to starve the workload's cores — monitoring losing
+    # a scrape beats monitoring stealing the TPU host's CPU.
+    scrape_sem: threading.BoundedSemaphore | None = None
+    scrape_queue_timeout_s: float = 0.25
+    scrape_rejects = None  # [int] mutable cell, shared per server
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
@@ -119,6 +127,25 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_text(404, b"not found\n")
 
     def _serve_metrics(self) -> None:
+        sem = self.scrape_sem
+        if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
+            if self.scrape_rejects is not None:
+                self.scrape_rejects[0] += 1  # GIL-atomic enough for a gauge
+            self.send_response(429)
+            self.send_header("Content-Type", "text/plain; charset=utf-8")
+            self.send_header("Retry-After", "1")
+            body = b"too many concurrent scrapes\n"
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        try:
+            self._serve_metrics_inner()
+        finally:
+            if sem is not None:
+                sem.release()
+
+    def _serve_metrics_inner(self) -> None:
         snap = self.store.current()
         # Content negotiation: Prometheus ≥2.5 advertises OpenMetrics in
         # Accept; both formats are served from lazily-cached bytes, so the
@@ -172,7 +199,10 @@ class MetricsServer:
         port: int = 8000,
         debug_vars=None,
         health_max_age_s: float = 0.0,
+        max_concurrent_scrapes: int = 4,
+        scrape_queue_timeout_s: float = 0.25,
     ) -> None:
+        self.scrape_rejects = [0]
         handler = type(
             "BoundHandler",
             (_Handler,),
@@ -180,6 +210,13 @@ class MetricsServer:
                 "store": store,
                 "debug_vars": staticmethod(debug_vars) if debug_vars else None,
                 "health_max_age_s": health_max_age_s,
+                "scrape_sem": (
+                    threading.BoundedSemaphore(max_concurrent_scrapes)
+                    if max_concurrent_scrapes > 0
+                    else None
+                ),
+                "scrape_queue_timeout_s": scrape_queue_timeout_s,
+                "scrape_rejects": self.scrape_rejects,
             },
         )
         self._httpd = _Server((host, port), handler)
