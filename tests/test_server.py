@@ -361,3 +361,27 @@ class TestScrapeConcurrencyGuard:
             assert get(f"http://127.0.0.1:{server.port}/metrics")[0] == 200
         finally:
             server.stop()
+
+
+def test_scrape_rejects_surface_as_self_metric():
+    """The 429 counter reaches the exporter's own exposition (and thus the
+    TpuExporterPollErrors-style alerting surface) on the next poll."""
+    from tpu_pod_exporter.app import ExporterApp
+    from tpu_pod_exporter.attribution.fake import FakeAttribution
+    from tpu_pod_exporter.backend.fake import FakeBackend
+    from tpu_pod_exporter.config import ExporterConfig
+
+    app = ExporterApp(
+        ExporterConfig(port=0, host="127.0.0.1", interval_s=30.0,
+                       backend="fake", fake_chips=1, attribution="none"),
+        backend=FakeBackend(chips=1), attribution=FakeAttribution(),
+    )
+    app.start()
+    try:
+        base = f"http://127.0.0.1:{app.port}"
+        assert b"tpu_exporter_scrape_rejects_total 0\n" in get(base + "/metrics")[2]
+        app.server.scrape_rejects[0] = 3  # as the guard would under a storm
+        app.collector.poll_once()
+        assert b"tpu_exporter_scrape_rejects_total 3\n" in get(base + "/metrics")[2]
+    finally:
+        app.stop()

@@ -1,0 +1,58 @@
+"""Tunnel watchdog tests: state-transition logging without any JAX init."""
+
+import json
+import socket
+import threading
+
+from tpu_pod_exporter import tunnelwatch
+
+
+def test_sample_never_initializes_jax():
+    import sys
+
+    before = sys.modules.get("jax")
+    s = tunnelwatch.sample()
+    assert set(s) == {"relay", "libtpu_8431"}
+    assert all(isinstance(v, bool) for v in s.values())
+    assert sys.modules.get("jax") is before  # port probes only
+
+
+def test_main_logs_transitions_only(tmp_path, monkeypatch):
+    out = tmp_path / "watch.jsonl"
+    states = iter([
+        {"relay": False, "libtpu_8431": False},
+        {"relay": False, "libtpu_8431": False},  # no change: not logged
+        {"relay": True, "libtpu_8431": False},   # transition: logged
+        {"relay": True, "libtpu_8431": False},
+    ])
+    monkeypatch.setattr(tunnelwatch, "sample", lambda: next(states))
+    monkeypatch.setattr(tunnelwatch.time, "sleep", lambda s: None)
+
+    calls = [0]
+    real_monotonic = tunnelwatch.time.monotonic
+
+    def monotonic():
+        calls[0] += 1
+        # Expire after the 4th sample's loop check.
+        return real_monotonic() + (1000.0 if calls[0] > 5 else 0.0)
+
+    monkeypatch.setattr(tunnelwatch.time, "monotonic", monotonic)
+    tunnelwatch.main(["--out", str(out), "--interval", "0",
+                      "--max-seconds", "1", "--heartbeat-every", "1000"])
+    records = [json.loads(l) for l in out.read_text().splitlines()]
+    assert [r["relay"] for r in records] == [False, True]
+    assert records[0]["change"] is True and records[1]["change"] is True
+
+
+def test_port_probe_detects_listener():
+    srv = socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(1)
+    port = srv.getsockname()[1]
+    t = threading.Thread(target=lambda: srv.accept(), daemon=True)
+    t.start()
+    try:
+        assert tunnelwatch._port_open(port)
+        assert not tunnelwatch._port_open(1)  # nothing on tcp/1
+    finally:
+        srv.close()

@@ -202,6 +202,9 @@ class ExporterApp:
             attribution_max_stale_s=cfg.attribution_max_stale_s,
             legacy_metrics=cfg.legacy_metrics,
             process_scanner=scanner,
+            # Deferred attribute read: self.server is constructed below;
+            # the first poll (in start()) runs after __init__ completes.
+            scrape_rejects_fn=lambda: self.server.scrape_rejects[0],
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots

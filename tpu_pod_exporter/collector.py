@@ -71,12 +71,14 @@ class Collector:
         attribution_max_stale_s: float = 30.0,
         legacy_metrics: bool = False,
         process_scanner=None,
+        scrape_rejects_fn=None,  # () -> int, from the HTTP guard
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
         self._backend = backend
         self._attribution = attribution
         self._process_scanner = process_scanner
+        self._scrape_rejects_fn = scrape_rejects_fn
         self._store = store
         self._topology = topology or HostTopology()
         self._resource_name = resource_name
@@ -498,6 +500,14 @@ class Collector:
         rss = self._read_rss_bytes()
         if rss is not None:
             b.add(schema.TPU_EXPORTER_RSS_BYTES, rss)
+        if self._scrape_rejects_fn is not None:
+            try:
+                b.add(
+                    schema.TPU_EXPORTER_SCRAPE_REJECTS_TOTAL,
+                    float(self._scrape_rejects_fn()),
+                )
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
 
         # ICI counter state lives in self._chip_state (pruned above when it
         # outgrows its bound: vanished chips only, never live ones).

@@ -220,6 +220,12 @@ TPU_EXPORTER_RSS_BYTES = MetricSpec(
     type=GAUGE,
 )
 
+TPU_EXPORTER_SCRAPE_REJECTS_TOTAL = MetricSpec(
+    name="tpu_exporter_scrape_rejects_total",
+    help="Scrapes rejected with 429 by the /metrics concurrency guard since start.",
+    type=COUNTER,
+)
+
 TPU_EXPORTER_INFO = MetricSpec(
     name="tpu_exporter_info",
     help="Static exporter build/runtime info; value is always 1.",
@@ -270,6 +276,7 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS,
     TPU_EXPORTER_CPU_SECONDS_TOTAL,
     TPU_EXPORTER_RSS_BYTES,
+    TPU_EXPORTER_SCRAPE_REJECTS_TOTAL,
     TPU_EXPORTER_INFO,
 )
 
