@@ -49,7 +49,13 @@ def test_port_probe_detects_listener():
     srv.bind(("127.0.0.1", 0))
     srv.listen(1)
     port = srv.getsockname()[1]
-    t = threading.Thread(target=lambda: srv.accept(), daemon=True)
+    def accept_quietly():
+        try:
+            srv.accept()
+        except OSError:  # srv.close() tears the socket down under us
+            pass
+
+    t = threading.Thread(target=accept_quietly, daemon=True)
     t.start()
     try:
         assert tunnelwatch._port_open(port)
