@@ -170,9 +170,9 @@ class TestSliceAggregator:
             "tpu_aggregator_scrape_errors_total", {"target": "h1:8000"}
         ) == 1.0
 
-    def test_garbage_body_counts_as_down_without_partial_sums(self):
+    def test_garbage_in_consumed_family_counts_as_down_without_partial_sums(self):
         self.pages["h1:8000"] = (
-            self.pages["h1:8000"] + 'broken{oops} not-a-number\n'
+            self.pages["h1:8000"] + 'tpu_hbm_used_bytes{oops} not-a-number\n'
         )
         self.agg().poll_once()
         snap = self.store.current()
@@ -182,6 +182,22 @@ class TestSliceAggregator:
             "tpu_slice_chip_count",
             {"slice_name": "slice-a", "accelerator": "v5p-64"},
         ) == 4.0
+
+    def test_garbage_outside_consumed_families_is_tolerated(self):
+        # The pre-parse name filter (CONSUMED_NAMES) means junk in families
+        # the aggregator never folds cannot corrupt sums — so the host
+        # stays up and its rollups intact (deliberate trade vs the test
+        # above; see parse_exposition's `names` docstring).
+        self.pages["h1:8000"] = (
+            self.pages["h1:8000"] + 'some_other_metric{oops} not-a-number\n'
+        )
+        self.agg().poll_once()
+        snap = self.store.current()
+        assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 1.0
+        assert snap.value(
+            "tpu_slice_chip_count",
+            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+        ) == 8.0
 
     def test_missing_host_label_not_counted_as_a_host(self):
         # An exporter that omits the host label must not collapse into a
@@ -401,3 +417,30 @@ class TestParseCacheConcurrency:
             assert parse_mod._block_cache_bytes == actual
         parse_mod._BLOCK_CACHE.clear()
         parse_mod._block_cache_bytes = 0
+
+
+class TestParseNameFilter:
+    def test_filter_skips_unlisted_names(self):
+        text = 'a{x="1"} 1\nb{x="2"} 2\nc 3\n'
+        names = [s.name for s in parse_exposition(text, names=frozenset({"b", "c"}))]
+        assert names == ["b", "c"]
+
+    def test_filter_skips_malformed_unlisted_lines(self):
+        # The filter runs before value parsing: garbage in an unconsumed
+        # family must not kill the round (documented trade-off).
+        text = 'junk{x="1"} not-a-number\nb 2\n'
+        (s,) = parse_exposition(text, names=frozenset({"b"}))
+        assert s.value == 2.0
+
+    def test_consumed_names_stays_in_sync_with_consume(self):
+        """CONSUMED_NAMES is a pre-parse filter: a name folded by _consume
+        but missing from the set would be silently dropped from rollups.
+        Lock the two together."""
+        import inspect
+        import re
+
+        from tpu_pod_exporter import aggregate as agg_mod
+
+        src = inspect.getsource(SliceAggregator._consume)
+        referenced = set(re.findall(r'"(tpu_[a-z_]+)"', src))
+        assert referenced == set(agg_mod.CONSUMED_NAMES)

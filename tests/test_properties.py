@@ -126,3 +126,57 @@ class TestOwnParserRoundtrip:
 
         (sample,) = parse_exposition(f"m {format_value(v)}\n")
         assert sample.value == v or (math.isnan(sample.value) and math.isnan(v))
+
+
+class TestFastBlockParseEquivalence:
+    """The non-regex fast path must be a strict subset of the regex parser:
+    wherever it answers at all, the answer is byte-identical; anything it
+    declines falls back (so overall accepted grammar never widens)."""
+
+    @given(
+        pairs=st.lists(
+            st.tuples(
+                st.from_regex(r"[A-Za-z_][A-Za-z0-9_]{0,15}", fullmatch=True),
+                label_values,
+            ),
+            min_size=0, max_size=6,
+        )
+    )
+    @settings(max_examples=300)
+    def test_fast_path_matches_regex_on_rendered_blocks(self, pairs):
+        from tpu_pod_exporter.metrics.parse import (
+            _parse_block_fast,
+            _parse_block_uncached,
+        )
+
+        def esc(v):
+            return v.replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+        block = ",".join(f'{k}="{esc(v)}"' for k, v in pairs)
+        want = _parse_block_uncached(block, block)
+        got = _parse_block_fast(block)
+        if got is not None:
+            assert got == want
+        else:
+            # Declines must have a reason the fast grammar can't express.
+            assert block == "" or "\\" in block or not block.endswith('"')
+
+    @given(block=st.text(max_size=60))
+    @settings(max_examples=300)
+    def test_fast_path_never_accepts_what_regex_rejects(self, block):
+        from tpu_pod_exporter.metrics.parse import (
+            ParseError,
+            _parse_block_fast,
+            _parse_block_uncached,
+        )
+
+        got = _parse_block_fast(block)
+        if got is None:
+            return
+        try:
+            want = _parse_block_uncached(block, block)
+        except ParseError:
+            raise AssertionError(
+                f"fast path accepted a block the regex rejects: {block!r}"
+            ) from None
+        assert got == want

@@ -32,6 +32,20 @@ from tpu_pod_exporter.collector import CollectorLoop
 from tpu_pod_exporter.metrics import CounterStore, SnapshotBuilder, SnapshotStore
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.parse import ParseError, parse_exposition
+
+# The only sample names _consume folds. Passed to parse_exposition as a
+# pre-parse filter: a 256-chip body is ~4k lines of which roughly half
+# (per-link counters, percents, info/self series) are irrelevant here —
+# skipping them before label parsing nearly halves round latency at
+# 64-host scale (bench_aggregate.py).
+CONSUMED_NAMES = frozenset({
+    "tpu_hbm_used_bytes",
+    "tpu_hbm_total_bytes",
+    "tpu_tensorcore_duty_cycle_percent",
+    "tpu_ici_link_bandwidth_bytes_per_second",
+    "tpu_pod_chip_count",
+    "tpu_pod_hbm_used_bytes",
+})
 from tpu_pod_exporter.server import MetricsServer
 from tpu_pod_exporter.utils import RateLimitedLogger
 
@@ -146,7 +160,7 @@ class SliceAggregator:
                 # leave a half-consumed host in the sums while the target is
                 # reported down.
                 try:
-                    samples = list(parse_exposition(text))
+                    samples = list(parse_exposition(text, names=CONSUMED_NAMES))
                 except ParseError as e:
                     ok = False
                     self._rlog.warning(
