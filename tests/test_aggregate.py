@@ -202,7 +202,11 @@ class TestSliceAggregator:
     def test_missing_host_label_not_counted_as_a_host(self):
         # An exporter that omits the host label must not collapse into a
         # phantom host "" in hosts_reporting; its chips still count.
+        # (tpu_chip_info is the per-chip presence series chips are counted
+        # from — round 4, when tpu_hbm_* became omissible.)
         nohost = (
+            'tpu_chip_info{chip_id="0",slice_name="slice-a",'
+            'accelerator="v5p-64"} 1\n'
             'tpu_hbm_used_bytes{chip_id="0",slice_name="slice-a",'
             'accelerator="v5p-64"} 1\n'
         )
@@ -444,3 +448,55 @@ class TestParseNameFilter:
         src = inspect.getsource(SliceAggregator._consume)
         referenced = set(re.findall(r'"(tpu_[a-z_]+)"', src))
         assert referenced == set(agg_mod.CONSUMED_NAMES)
+
+
+class TestUnreadableHbmHostsStillCounted:
+    def test_host_with_no_hbm_series_keeps_chip_count_and_reporting(self):
+        """Code-review r4: a healthy host on an HBM-less backend (tunnel)
+        publishes no tpu_hbm_* series; it must still contribute chips and
+        hosts_reporting via tpu_chip_info."""
+        from tpu_pod_exporter.backend import ChipInfo, ChipSample, HostSample
+        from tpu_pod_exporter.backend.fake import FakeBackend
+        from tpu_pod_exporter.collector import Collector
+
+        class NoHbmBackend(FakeBackend):
+            def sample(self):
+                chips = tuple(
+                    ChipSample(
+                        info=ChipInfo(
+                            chip_id=i, device_path=f"/dev/accel{i}",
+                            device_ids=(str(i),),
+                        ),
+                        hbm_used_bytes=None,
+                        hbm_total_bytes=None,
+                    )
+                    for i in range(4)
+                )
+                return HostSample(chips=chips,
+                                  partial_errors=("hbm unreadable",) * 4)
+
+        store = SnapshotStore()
+        topo = HostTopology(
+            accelerator="v5p-64", slice_name="slice-a",
+            host="host-0", worker_id="0",
+        )
+        c = Collector(NoHbmBackend(chips=0), FakeAttribution(), store, topology=topo)
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "tpu_hbm_used_bytes{" not in text  # honesty preserved
+
+        agg_store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000",), agg_store, fetch=StaticFetch({"h0:8000": text})
+        )
+        agg.poll_once()
+        agg.close()
+        snap = agg_store.current()
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        assert snap.value("tpu_slice_chip_count", key) == 4.0
+        assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
+        # ...but the slice HBM rollups stay ABSENT (not fake zeros): no
+        # chip reported a readable HBM value this round.
+        assert snap.value("tpu_slice_hbm_used_bytes", key) is None
+        assert snap.value("tpu_slice_hbm_total_bytes", key) is None
+        assert snap.value("tpu_slice_hbm_used_percent", key) is None

@@ -392,14 +392,21 @@ class TestTelemetryDepth:
         info_labels = dict(chip_labels(1), device_kind="TPU v5p", coords="1,0,0")
         assert snap.value("tpu_chip_info", info_labels) == 1.0
 
-    def test_peak_and_info_absent_when_unknown(self, store, four_chip_backend):
+    def test_peak_absent_when_unknown_but_info_always_present(
+        self, store, four_chip_backend
+    ):
         c = make_collector(four_chip_backend, FakeAttribution(), store)
         c.poll_once()
         text = store.current().encode().decode()
-        # Families declared (stable surface), but no samples.
+        # Peak family declared (stable surface) but no samples.
         assert "# TYPE tpu_hbm_peak_bytes gauge" in text
         assert "\ntpu_hbm_peak_bytes{" not in text
-        assert "\ntpu_chip_info{" not in text
+        # chip_info, by contrast, is the guaranteed per-chip presence
+        # series (round 4: tpu_hbm_* became omissible, so the aggregator
+        # counts chips from chip_info) — published even with empty
+        # kind/coords labels.
+        assert text.count("\ntpu_chip_info{") == 4
+        assert 'device_kind="",coords=""' in text
 
     def test_self_usage_metrics(self, store, four_chip_backend):
         import sys
@@ -459,3 +466,47 @@ class TestSideChannelErrorNamespacing:
         assert snap.value(
             "tpu_exporter_poll_errors_total", {"source": "attribution.attribution"}
         ) == 99.0
+
+
+class TestPodRollupHonesty:
+    """Code-review r4: pod/legacy rollups must not fold unreadable (None)
+    HBM as 0 — same absent-beats-fake-zero rule as the per-chip series."""
+
+    def _none_hbm_backend(self, chips=2):
+        from tpu_pod_exporter.backend import ChipInfo, ChipSample, HostSample
+
+        class NoHbmBackend(FakeBackend):
+            def sample(self):
+                return HostSample(chips=tuple(
+                    ChipSample(
+                        info=ChipInfo(chip_id=i, device_path=f"/dev/accel{i}",
+                                      device_ids=(str(i),)),
+                        hbm_used_bytes=None, hbm_total_bytes=None,
+                    ) for i in range(chips)
+                ))
+
+        return NoHbmBackend(chips=0)
+
+    def test_fully_unreadable_pod_omits_hbm_series_keeps_chip_count(self, store, one_pod_attribution):
+        c = make_collector(self._none_hbm_backend(), one_pod_attribution, store)
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "tpu_pod_chip_count{" in text
+        assert "tpu_pod_hbm_used_bytes{" not in text
+
+    def test_fully_unreadable_pod_emits_no_legacy_series(self, store, one_pod_attribution):
+        c = make_collector(self._none_hbm_backend(), one_pod_attribution, store,
+                           legacy_metrics=True)
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "pod_gpu_memory_usage{" not in text
+        assert "docker_gpu_memory_perc_usage{" not in text
+
+    def test_chip_info_always_published(self, store):
+        c = make_collector(self._none_hbm_backend(), FakeAttribution(), store)
+        c.poll_once()
+        # Even with empty device_kind/coords: chip presence is guaranteed.
+        assert store.current().value(
+            "tpu_chip_info",
+            {**chip_labels(0), "device_kind": "", "coords": ""},
+        ) == 1.0

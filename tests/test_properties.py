@@ -157,9 +157,31 @@ class TestFastBlockParseEquivalence:
         got = _parse_block_fast(block)
         if got is not None:
             assert got == want
-        else:
-            # Declines must have a reason the fast grammar can't express.
-            assert block == "" or "\\" in block or not block.endswith('"')
+        # A decline is always safe: the caller falls back to the regex
+        # parser (asserted by `want` parsing above), so accepted grammar
+        # and results are unchanged. Declines beyond the obvious ones
+        # (escapes, no trailing quote) exist — e.g. a value ending in a
+        # comma makes the quote-comma split ambiguous, and the fast path
+        # correctly refuses rather than guess.
+
+    def test_fast_path_actually_accepts_the_common_shape(self):
+        """Guard that the optimization applies at all: the exact block
+        shape the collector renders must take the fast path (a regression
+        to always-decline would silently lose the perf the path exists
+        for)."""
+        from tpu_pod_exporter.metrics.parse import _parse_block_fast
+
+        block = (
+            'chip_id="0",device_path="/dev/accel0",accelerator="v5p-64",'
+            'slice_name="s",host="h0",worker_id="0",pod="p",namespace="ml",'
+            'container="main"'
+        )
+        assert _parse_block_fast(block) == {
+            "chip_id": "0", "device_path": "/dev/accel0",
+            "accelerator": "v5p-64", "slice_name": "s", "host": "h0",
+            "worker_id": "0", "pod": "p", "namespace": "ml",
+            "container": "main",
+        }
 
     @given(block=st.text(max_size=60))
     @settings(max_examples=300)

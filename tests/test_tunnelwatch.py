@@ -62,3 +62,21 @@ def test_port_probe_detects_listener():
         assert not tunnelwatch._port_open(1)  # nothing on tcp/1
     finally:
         srv.close()
+
+
+def test_heartbeat_every_one_records_every_sample(tmp_path, monkeypatch):
+    out = tmp_path / "watch.jsonl"
+    states = iter([{"relay": False, "libtpu_8431": False}] * 3)
+    monkeypatch.setattr(tunnelwatch, "sample", lambda: next(states))
+    monkeypatch.setattr(tunnelwatch.time, "sleep", lambda s: None)
+    calls = [0]
+    real = tunnelwatch.time.monotonic
+
+    def monotonic():
+        calls[0] += 1
+        return real() + (1000.0 if calls[0] > 4 else 0.0)
+
+    monkeypatch.setattr(tunnelwatch.time, "monotonic", monotonic)
+    tunnelwatch.main(["--out", str(out), "--interval", "0",
+                      "--max-seconds", "1", "--heartbeat-every", "1"])
+    assert len(out.read_text().splitlines()) == 3  # one record per sample

@@ -39,6 +39,7 @@ from tpu_pod_exporter.metrics.parse import ParseError, parse_exposition
 # skipping them before label parsing nearly halves round latency at
 # 64-host scale (bench_aggregate.py).
 CONSUMED_NAMES = frozenset({
+    "tpu_chip_info",
     "tpu_hbm_used_bytes",
     "tpu_hbm_total_bytes",
     "tpu_tensorcore_duty_cycle_percent",
@@ -73,14 +74,19 @@ def default_fetch(target: str, timeout_s: float) -> str:
 class _SliceAgg:
     """Mutable per-(slice, accelerator) accumulator for one round."""
 
-    __slots__ = ("hosts", "chips", "hbm_used", "hbm_total", "duty_sum",
-                 "duty_n", "ici_bw")
+    __slots__ = ("hosts", "chips", "hbm_used", "hbm_total", "hbm_used_n",
+                 "hbm_total_n", "duty_sum", "duty_n", "ici_bw")
 
     def __init__(self) -> None:
         self.hosts: set[str] = set()
         self.chips = 0
         self.hbm_used = 0.0
         self.hbm_total = 0.0
+        # Sample counts: a slice whose chips published NO hbm series (HBM
+        # unreadable on that backend — see collector.py round 4) must omit
+        # the slice HBM rollups too, not publish fake zeros.
+        self.hbm_used_n = 0
+        self.hbm_total_n = 0
         self.duty_sum = 0.0
         self.duty_n = 0
         self.ici_bw = 0.0
@@ -178,13 +184,19 @@ class SliceAggregator:
         for key, agg in slices.items():
             b.add(schema.TPU_SLICE_HOSTS_REPORTING, float(len(agg.hosts)), key)
             b.add(schema.TPU_SLICE_CHIP_COUNT, float(agg.chips), key)
-            b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
-            b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
-            b.add(
-                schema.TPU_SLICE_HBM_USED_PERCENT,
-                schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
-                key,
-            )
+            # Emitted only when at least one chip actually reported HBM —
+            # absent beats fake-zero, same rule the exporter applies to
+            # per-chip and per-pod series.
+            if agg.hbm_used_n:
+                b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
+            if agg.hbm_total_n:
+                b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
+            if agg.hbm_used_n and agg.hbm_total_n:
+                b.add(
+                    schema.TPU_SLICE_HBM_USED_PERCENT,
+                    schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
+                    key,
+                )
             if agg.duty_n:
                 b.add(
                     schema.TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
@@ -213,19 +225,32 @@ class SliceAggregator:
         """Fold one host's parsed samples into the round accumulators."""
         for s in samples:
             name = s.name
-            if name == "tpu_hbm_used_bytes":
+            if name == "tpu_chip_info":
+                # The one guaranteed per-chip series (round 4: a chip whose
+                # HBM is unreadable publishes NO tpu_hbm_* series, so chip
+                # presence and hosts_reporting must not key off those).
+                # Presence intentionally keys on chip_info ALONE: exporters
+                # have published it unconditionally since the same change,
+                # and a dual-source count (chip_info OR hbm series) would
+                # risk double-counting; mixed fleets older than that are
+                # not supported.
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.chips += 1
-                agg.hbm_used += s.value
                 # A missing host label must not count as host "" — mixed
                 # with exporters that omit the label, all such hosts would
                 # collapse into one and undercount hosts_reporting. The
-                # sample still contributes to chip/HBM sums above.
+                # sample still contributes to the chip count above.
                 host = s.labels.get("host")
                 if host:
                     agg.hosts.add(host)
+            elif name == "tpu_hbm_used_bytes":
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.hbm_used += s.value
+                agg.hbm_used_n += 1
             elif name == "tpu_hbm_total_bytes":
-                SliceAggregator._slice(slices, s.labels).hbm_total += s.value
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.hbm_total += s.value
+                agg.hbm_total_n += 1
             elif name == "tpu_tensorcore_duty_cycle_percent":
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.duty_sum += s.value

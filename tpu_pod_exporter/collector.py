@@ -266,7 +266,8 @@ class Collector:
             for h in holders:
                 holders_by_path.setdefault(h.device_path, []).append(h)
 
-        pod_rollup: dict[tuple[str, ...], list[float]] = {}  # labels -> [chips, hbm_used, hbm_total]
+        # labels -> [chips, hbm_used, chips_with_readable_hbm]
+        pod_rollup: dict[tuple[str, ...], list[float]] = {}
         # (pod, pid) -> [hbm_used, hbm_total] for the legacy aliases; pid is
         # "" when no process scanner or no holder was seen for the chip.
         legacy_rollup: dict[tuple[str, str], list[float]] = {}
@@ -335,11 +336,12 @@ class Collector:
                     )
                     # device_kind/coords are static per chip: build the
                     # tpu_chip_info label tuple once here, not per poll.
-                    info_tuple = (
-                        chip_tuple + (info.device_kind, info.coords)
-                        if (info.device_kind or info.coords)
-                        else None
-                    )
+                    # ALWAYS published (empty kind/coords stay empty labels):
+                    # since round 4 a chip with unreadable HBM emits no
+                    # tpu_hbm_* series, so chip_info is the one guaranteed
+                    # per-chip presence series — the aggregator counts
+                    # chips/hosts_reporting from it.
+                    info_tuple = chip_tuple + (info.device_kind, info.coords)
                     cached = (chip_tuple, {}, info_tuple)
                     label_cache[cache_key] = cached
                 chip_tuple, link_tuples, info_tuple = cached
@@ -361,8 +363,7 @@ class Collector:
                     hbm_peak_s[chip_tuple] = chip.hbm_peak_bytes
                 if chip.tensorcore_duty_cycle_percent is not None:
                     duty_s[chip_tuple] = chip.tensorcore_duty_cycle_percent
-                if info_tuple is not None:
-                    chip_info_s[info_tuple] = 1.0
+                chip_info_s[info_tuple] = 1.0
 
                 # Link work is deferred to the fold pass below; here the fast
                 # path only verifies layout identity and extracts raw totals.
@@ -401,22 +402,35 @@ class Collector:
 
                 if owner is not None:
                     rk = (owner.pod, owner.namespace) + self._topo_tuple
-                    agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0.0])
+                    # [chips, hbm_used, chips_with_readable_hbm]
+                    agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0])
                     agg[0] += 1.0
-                    agg[1] += used or 0.0
-                    agg[2] += total_b or 0.0
-                    if self._legacy_metrics:
+                    # Unreadable (None) HBM contributes nothing — and if NO
+                    # chip of the pod was readable, the pod HBM series is
+                    # omitted below, same absent-beats-fake-zero rule as the
+                    # per-chip series.
+                    if used is not None:
+                        agg[1] += used
+                        agg[2] += 1
+                    if (
+                        self._legacy_metrics
+                        and used is not None
+                        and total_b is not None
+                    ):
                         # The legacy shape has no namespace label (the
                         # reference collided on pod name, main.go:113); sum
                         # across namespaces rather than last-write-wins. With
                         # the process scanner on, the pid label carries the
                         # chip's primary (lowest-pid) holder so each chip's
                         # HBM is counted exactly once even under forked
-                        # workers; "" otherwise.
+                        # workers; "" otherwise. A chip missing EITHER HBM
+                        # number is skipped entirely: half-folding would
+                        # publish a fake-zero usage row or skew the percent
+                        # denominator (used without total → pct inflation).
                         pid = str(chip_holders[0].pid) if chip_holders else ""
                         lagg = legacy_rollup.setdefault((owner.pod, pid), [0.0, 0.0])
-                        lagg[0] += used or 0.0
-                        lagg[1] += total_b or 0.0
+                        lagg[0] += used
+                        lagg[1] += total_b
 
             if fast:
                 self._fold_ici_fast(ici_total_s, ici_bw_s, dt, seq)
@@ -424,9 +438,10 @@ class Collector:
                 self._fold_ici_slow(chip_cached, ici_total_s, ici_bw_s, dt, seq)
             self._prev_ici_at = now_mono
 
-        for rk, (nchips, hbm, hbm_total) in pod_rollup.items():
+        for rk, (nchips, hbm, readable) in pod_rollup.items():
             b.add(schema.TPU_POD_CHIP_COUNT, nchips, rk)
-            b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
+            if readable:
+                b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
         for (pod, pid), (hbm, hbm_total) in legacy_rollup.items():
             # Reference-name aliases (main.go:24,31), label shape {pid, pod}.
             b.add(schema.LEGACY_POD_MEMORY_USAGE, hbm, (pid, pod))

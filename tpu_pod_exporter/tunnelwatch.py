@@ -62,7 +62,9 @@ def main(argv=None) -> int:
     while deadline is None or time.monotonic() < deadline:
         state = sample()
         n += 1
-        if state != prev or (n % args.heartbeat_every) == 1:
+        # `1 % every` (not a bare 1) so --heartbeat-every 1 records every
+        # sample instead of never matching.
+        if state != prev or (n % args.heartbeat_every) == 1 % args.heartbeat_every:
             rec = {"t": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                    "change": state != prev, **state}
             with open(args.out, "a") as f:
