@@ -81,7 +81,7 @@ TPU_HBM_PEAK_BYTES = MetricSpec(
 # device_kind and torus coords; the libtpu metrics service does not).
 TPU_CHIP_INFO = MetricSpec(
     name="tpu_chip_info",
-    help="Static chip identity; value is always 1. coords is the chip's torus position (x,y,z).",
+    help="Static chip identity; value is always 1. coords is the chip's torus position (x,y,z). Published for every chip each round (possibly with empty kind/coords) — the guaranteed per-chip presence series that slice rollups count chips from, since tpu_hbm_* may be absent on backends that cannot read HBM.",
     type=GAUGE,
     label_names=CHIP_LABELS + ("device_kind", "coords"),
 )
