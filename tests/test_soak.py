@@ -52,8 +52,33 @@ def _scrape(port: int, path: str = "/metrics") -> str:
         return r.read().decode()
 
 
+# One host runs a backend whose HBM is unreadable every poll (the tunnel
+# shape, HARDWARE.md): it must stay in hosts_reporting/chip counts for the
+# whole soak while publishing no tpu_hbm_* series.
+HBM_LESS_HOST = 7
+
+
+class _HbmLessBackend(FakeBackend):
+    def sample(self):
+        from tpu_pod_exporter.backend import ChipSample, HostSample
+
+        real = super().sample()
+        return HostSample(
+            chips=tuple(
+                ChipSample(info=c.info, hbm_used_bytes=None,
+                           hbm_total_bytes=None,
+                           tensorcore_duty_cycle_percent=c.tensorcore_duty_cycle_percent,
+                           ici_links=c.ici_links)
+                for c in real.chips
+            ),
+            partial_errors=real.partial_errors
+            + tuple(f"device {c.info.chip_id}: memory_stats empty" for c in real.chips),
+        )
+
+
 def _make_host(worker_id: int):
-    backend = FakeBackend(
+    cls = _HbmLessBackend if worker_id == HBM_LESS_HOST else FakeBackend
+    backend = cls(
         chips=CHIPS_PER_HOST,
         script=FakeChipScript(
             hbm_total_bytes=96 * GIB,
@@ -195,6 +220,13 @@ def test_full_stack_churn_soak():
                 assert f'pod="job-gen{g}"' not in text, (
                     f"host {i} leaked series of generation {g}"
                 )
+            if i == HBM_LESS_HOST:
+                # Unreadable HBM for the whole soak: presence series yes,
+                # HBM series never (absent beats fake-zero), and the
+                # partial errors were counted every poll.
+                assert "tpu_hbm_used_bytes{" not in text
+                assert text.count("tpu_chip_info{") == CHIPS_PER_HOST
+                assert 'source="device_partial"' in text
         # Aggregator rebuilt per round: its workload rollup carries only
         # the live generation too.
         agg_snap = agg_store.current()
