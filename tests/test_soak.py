@@ -60,15 +60,16 @@ HBM_LESS_HOST = 7
 
 class _HbmLessBackend(FakeBackend):
     def sample(self):
-        from tpu_pod_exporter.backend import ChipSample, HostSample
+        from tpu_pod_exporter.backend import HostSample
 
         real = super().sample()
         return HostSample(
             chips=tuple(
-                ChipSample(info=c.info, hbm_used_bytes=None,
-                           hbm_total_bytes=None,
-                           tensorcore_duty_cycle_percent=c.tensorcore_duty_cycle_percent,
-                           ici_links=c.ici_links)
+                # _replace nulls ONLY the HBM fields — every other (and any
+                # future) ChipSample field keeps flowing, so the soak shape
+                # stays a real backend's shape minus HBM.
+                c._replace(hbm_used_bytes=None, hbm_total_bytes=None,
+                           hbm_peak_bytes=None)
                 for c in real.chips
             ),
             partial_errors=real.partial_errors

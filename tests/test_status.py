@@ -104,6 +104,8 @@ class TestStatusCli:
         chip0 = doc["chips"][0]
         assert chip0["device_path"] == "/dev/accel0"
         assert chip0["holders"] == [{"pid": 42, "comm": "w", "pod_uid": ""}]
+        assert isinstance(chip0["ici"], dict)  # per-link counters (r4)
+        assert doc["partial_errors"] == []
         assert doc["pods"] == []
 
     def test_json_zero_chips(self, run_status):

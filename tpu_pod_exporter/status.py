@@ -146,6 +146,11 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
                 "hbm_total_bytes": chip.hbm_total_bytes,
                 "hbm_peak_bytes": chip.hbm_peak_bytes,
                 "duty_cycle_percent": chip.tensorcore_duty_cycle_percent,
+                # Per-link cumulative ICI counters (link="all" on backends
+                # serving only a per-chip aggregate — see backend/libtpu.py).
+                "ici": {
+                    l.link: l.transferred_bytes_total for l in chip.ici_links
+                },
                 "pod": owner.pod if owner else None,
                 "namespace": owner.namespace if owner else None,
                 "container": owner.container if owner else None,
@@ -193,6 +198,9 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
             "host": topo.host,
             "worker_id": topo.worker_id,
             "chips": doc_chips,
+            # Machine-readable too, not just the stderr warnings: an
+            # hbm_used_bytes of null is only diagnosable with these.
+            "partial_errors": list(sample.partial_errors),
             "pods": [
                 {"namespace": ns_, "pod": pod, "chips": int(n),
                  "hbm_used_bytes": hbm}
