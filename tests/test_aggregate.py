@@ -500,3 +500,60 @@ class TestUnreadableHbmHostsStillCounted:
         assert snap.value("tpu_slice_hbm_used_bytes", key) is None
         assert snap.value("tpu_slice_hbm_total_bytes", key) is None
         assert snap.value("tpu_slice_hbm_used_percent", key) is None
+
+
+class TestAggregatorCli:
+    def test_cli_end_to_end_with_sigterm_drain(self):
+        """python -m tpu_pod_exporter.aggregate against a live exporter:
+        serves rollups over HTTP, drains cleanly on SIGTERM (the deploy
+        manifest's termination path)."""
+        import signal
+        import socket
+        import subprocess
+        import sys
+        import time
+
+        from tpu_pod_exporter.app import ExporterApp
+
+        app = ExporterApp(
+            ExporterConfig(
+                port=0, host="127.0.0.1", interval_s=0.2,
+                backend="fake", fake_chips=2, attribution="none",
+                accelerator="v4-8", slice_name="sa", node_name="n0",
+            )
+        )
+        app.start()
+        # Grab a free port for the aggregator (bind+close; tiny race is
+        # acceptable in CI, and EADDRINUSE would fail loudly anyway).
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        agg_port = s.getsockname()[1]
+        s.close()
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "tpu_pod_exporter.aggregate",
+             "--targets", f"127.0.0.1:{app.port}",
+             "--host", "127.0.0.1", "--port", str(agg_port),
+             "--interval-s", "0.2"],
+        )
+        try:
+            deadline = time.monotonic() + 20
+            body = ""
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{agg_port}/metrics", timeout=2
+                    ) as r:
+                        body = r.read().decode()
+                    if "tpu_slice_chip_count" in body:
+                        break
+                except OSError:
+                    pass
+                time.sleep(0.2)
+            assert 'tpu_slice_chip_count{slice_name="sa",accelerator="v4-8"} 2' in body
+            assert "tpu_aggregator_target_up" in body
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=15) == 0  # clean drain
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+            app.stop()
