@@ -581,3 +581,29 @@ class TestEnumerationSanityCheck:
         backend.sample()
         assert ICI_TRANSFERRED not in service.calls  # enumeration trusted
         backend.close()
+
+
+class TestProbeToolPerLink:
+    def test_probe_records_link_attribute_in_fixture(self, metric_server):
+        """A runtime serving two-attribute ICI rows must ground-truth the
+        link axis into the committed fixture (attr_keys + per-row link),
+        so a future real TPU VM probe captures the per-link shape."""
+        import json
+
+        from tpu_pod_exporter.probe import probe
+
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB)])
+        service.supported = [HBM_USAGE, ICI_TRANSFERRED]
+        service.tables[ICI_TRANSFERRED] = link_response(
+            [(0, 0, 11), (0, 1, 22)]
+        )
+        report = probe(addr, timeout_s=2.0)
+        json.dumps(report)  # fixture must stay strict-JSON
+        m = report["metrics"][ICI_TRANSFERRED]
+        assert m["rows"] == 2
+        assert m["attr_keys"] == ["device-id", "link-id"]
+        assert m["sample"] == [
+            {"attr": "0", "link": "0", "value": 11},
+            {"attr": "0", "link": "1", "value": 22},
+        ]
