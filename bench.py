@@ -115,21 +115,55 @@ def main() -> int:
         for _ in range(10):
             http_get("127.0.0.1", port, "/metrics")
 
-        ccpu0 = proc_cpu_seconds(child_pid)
-        wall0 = time.monotonic()
+        # Latency phase, PACED below the exporter's scrape-rate cap
+        # (config.max_scrapes_per_s, default 100/s): p99 must measure what
+        # a real scraper sees, and real scrapers are 1 Hz — an unpaced
+        # tight loop would measure the 429 wall instead.
+        pace_hz = 80.0
         lat: list[float] = []
         body_len = 0
+        paced_rejects = 0
+        next_at = time.monotonic()
         for _ in range(scrapes):
+            next_at += 1.0 / pace_hz
             t0 = time.perf_counter()
             body = http_get("127.0.0.1", port, "/metrics")
             lat.append((time.perf_counter() - t0) * 1e3)
-            body_len = len(body)
-        wall1 = time.monotonic()
-        ccpu1 = proc_cpu_seconds(child_pid)
+            if b" 429 " in body.split(b"\r\n", 1)[0]:
+                paced_rejects += 1
+            else:
+                body_len = len(body)
+            delay = next_at - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+        if paced_rejects:
+            # ANY mid-run reject poisons the latency sample (tarpit sleeps
+            # and 29-byte rejects would masquerade as scrape latencies).
+            print(json.dumps({
+                "error": "paced latency phase hit the rate cap",
+                "rejects": paced_rejects,
+            }))
+            return 1
 
         lat.sort()
         p50 = percentile(lat, 50)
         p99 = percentile(lat, 99)
+
+        # Storm phase: hammer /metrics flat out. The rate cap means the
+        # exporter serves ~max_scrapes_per_s full bodies and answers the
+        # rest with the pre-rendered 429 — the number that matters is how
+        # much of a core the storm can steal from the TPU host.
+        served = rejected = 0
+        ccpu0 = proc_cpu_seconds(child_pid)
+        wall0 = time.monotonic()
+        while time.monotonic() - wall0 < 6.0:
+            resp = http_get("127.0.0.1", port, "/metrics")
+            if b" 429 " in resp.split(b"\r\n", 1)[0]:
+                rejected += 1
+            else:
+                served += 1
+        wall1 = time.monotonic()
+        ccpu1 = proc_cpu_seconds(child_pid)
         burst_cpu_s = ccpu1 - ccpu0  # exporter-only, via /proc
         burst_wall_s = max(wall1 - wall0, 1e-9)
 
@@ -172,8 +206,10 @@ def main() -> int:
             # The scrape client's own cost, formerly conflated into the
             # number above:
             "bench_client_cpu_percent_1hz": round(client_cpu_pct, 2),
-            "burst_scrapes_per_s": round(scrapes / burst_wall_s, 1),
+            "burst_scrapes_per_s": round((served + rejected) / burst_wall_s, 1),
             "burst_cpu_percent": round(100.0 * burst_cpu_s / burst_wall_s, 1),
+            "burst_served_per_s": round(served / burst_wall_s, 1),
+            "burst_rejected_per_s": round(rejected / burst_wall_s, 1),
             "scrapes": scrapes,
         }
         print(json.dumps(result))

@@ -602,6 +602,17 @@ class TestAggregateHonesty:
             self._aggregate(text)
         assert any("old-host" in r.message for r in caplog.records)
 
+    def test_slice_ici_omitted_when_no_chip_reported_ici(self):
+        # Code-review r5: a fleet on a runtime without ICI counters must
+        # not publish tpu_slice_ici_bytes_per_second 0.0 ("idle" != "unmeasured").
+        text = (
+            'tpu_chip_info{chip_id="0",host="host-0",slice_name="slice-a",'
+            'accelerator="v5p-64"} 1\n'
+        )
+        snap = self._aggregate(text)
+        assert snap.value("tpu_slice_chip_count", self.KEY) == 1.0
+        assert snap.value("tpu_slice_ici_bytes_per_second", self.KEY) is None
+
     def test_orphan_hbm_host_warns_once(self, caplog):
         # A host contributing HBM sums but zero chip_info rows (exporter
         # older than the unconditional-chip_info change) must log loudly:

@@ -201,6 +201,32 @@ class TestLibtpuBackend:
         assert any("usage missing" in e for e in sample.partial_errors)
         backend.close()
 
+    def test_junk_device_key_in_ici_response_is_dropped_not_enumerated(
+        self, metric_server
+    ):
+        # Code-review r5: a mis-parsed single-attribute ICI row (its value
+        # a link id like "x+") must not fabricate a phantom chip nor flip
+        # every real chip's id scheme to positional.
+        service, addr = metric_server
+        service.set(HBM_USAGE, [(0, GIB), (1, GIB)])
+        service.set(HBM_TOTAL, [(0, 32 * GIB), (1, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0), (1, 2.0)])
+        junk = pb.MetricResponse()
+        m = junk.metric.metrics.add()
+        a = m.attribute.add()
+        a.key = "link-id"  # no device attribute at all
+        a.value.string_attr = "x+"
+        m.gauge.as_int = 123
+        service.tables[ICI_TRANSFERRED] = junk
+        backend = LibtpuMetricsBackend(
+            addr=addr, device_paths={0: "/dev/accel0", 1: "/dev/accel1"}
+        )
+        sample = backend.sample()
+        assert [c.info.chip_id for c in sample.chips] == [0, 1]
+        assert sample.chips[0].info.device_path == "/dev/accel0"
+        assert any("non-numeric device key" in e for e in sample.partial_errors)
+        backend.close()
+
     def test_duty_only_device_still_enumerates(self, metric_server):
         service, addr = metric_server
         service.set(HBM_USAGE, [(0, GIB)])

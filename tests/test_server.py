@@ -429,6 +429,87 @@ class TestScrapeConcurrencyGuard:
             server.stop()
 
 
+class TestScrapeRateCap:
+    """VERDICT r4 #5: a sequential storm of full-body scrapes is pure
+    kernel-copy CPU the concurrency guard cannot bound — above the token
+    bucket's rate, scrapes get the pre-rendered 429 instead."""
+
+    def test_storm_hits_rate_cap_then_recovers(self):
+        import time
+
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, max_scrapes_per_s=5.0,
+            scrape_tarpit_s=0.0,  # keep the test fast; tarpit tested below
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            # Burst capacity is 2×rate = 10 tokens; 30 back-to-back scrapes
+            # must drain it and hit the wall.
+            statuses = [get(base + "/metrics")[0] for _ in range(30)]
+            assert statuses[0] == 200           # bucket starts full
+            assert statuses.count(429) >= 10    # the wall is real
+            assert server.scrape_rejects[0] == statuses.count(429)
+            # Refill: at 5/s, one token comes back well within a second.
+            time.sleep(0.5)
+            assert get(base + "/metrics")[0] == 200
+            # Health endpoints are never rate-capped.
+            assert get(base + "/healthz")[0] == 200
+        finally:
+            server.stop()
+
+    def test_rate_cap_reject_is_tarpitted(self):
+        # A fast 429 just speeds the storm's retry loop up; the reject must
+        # hold the client for ~scrape_tarpit_s (cost: one sleeping thread,
+        # not CPU).
+        import time
+
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, max_scrapes_per_s=0.5,
+            scrape_tarpit_s=0.2,
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            for _ in range(2):  # drain the 1-token bucket (refill 0.5/s)
+                get(base + "/metrics")
+            t0 = time.monotonic()
+            status = get(base + "/metrics")[0]
+            elapsed = time.monotonic() - t0
+            assert status == 429
+            assert elapsed >= 0.15
+        finally:
+            server.stop()
+
+    def test_rate_cap_disabled_by_default(self):
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0)
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            statuses = [get(base + "/metrics")[0] for _ in range(30)]
+            assert statuses == [200] * 30
+        finally:
+            server.stop()
+
+    def test_token_bucket_refills_to_burst_not_beyond(self):
+        from tpu_pod_exporter.server import _TokenBucket
+
+        b = _TokenBucket(rate=10.0, burst=3.0)
+        assert [b.take() for _ in range(3)] == [True] * 3
+        # Bucket just drained; an immediate take fails (refill in the
+        # microseconds since is « 1 token at 10/s).
+        assert b.take() is False
+        b.last -= 10.0  # simulate 10 s idle: refill clamps at burst
+        assert [b.take() for _ in range(3)] == [True] * 3
+        assert b.take() is False
+
+
 def test_scrape_rejects_surface_as_self_metric():
     """The 429 counter reaches the exporter's own exposition (and thus the
     TpuExporterPollErrors-style alerting surface) on the next poll."""

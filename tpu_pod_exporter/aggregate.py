@@ -76,7 +76,7 @@ class _SliceAgg:
 
     __slots__ = ("hosts", "chip_series_hosts", "chips", "hbm_used",
                  "hbm_total", "used_chips", "total_chips", "duty_sum",
-                 "duty_n", "ici_bw")
+                 "duty_n", "ici_bw", "ici_n")
 
     def __init__(self) -> None:
         self.hosts: set[str] = set()
@@ -97,6 +97,10 @@ class _SliceAgg:
         self.duty_sum = 0.0
         self.duty_n = 0
         self.ici_bw = 0.0
+        # Same rule as duty/HBM: a slice with NO ICI samples (runtime
+        # without ICI counters) omits the rollup — 0.0 would read as
+        # "interconnect idle", not "unmeasured".
+        self.ici_n = 0
 
 
 class _WorkloadAgg:
@@ -236,7 +240,8 @@ class SliceAggregator:
                     agg.duty_sum / agg.duty_n,
                     key,
                 )
-            b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
+            if agg.ici_n:
+                b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
 
         for key, w in workloads.items():
             b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
@@ -301,6 +306,7 @@ class SliceAggregator:
             elif name == "tpu_ici_link_bandwidth_bytes_per_second":
                 agg = SliceAggregator._slice(slices, s.labels)
                 agg.ici_bw += s.value
+                agg.ici_n += 1
                 host = s.labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
@@ -349,6 +355,8 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--interval-s", type=float, default=5.0)
     p.add_argument("--timeout-s", type=float, default=2.0)
+    p.add_argument("--max-scrapes-per-s", type=float, default=100.0,
+                   help="rate-cap own /metrics (token bucket; 0 disables)")
     p.add_argument("--log-level", default="info")
     ns = p.parse_args(argv)
     logging.basicConfig(
@@ -363,6 +371,7 @@ def main(argv: list[str] | None = None) -> int:
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
+        max_scrapes_per_s=ns.max_scrapes_per_s,
     )
 
     stop = threading.Event()

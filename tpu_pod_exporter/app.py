@@ -217,6 +217,7 @@ class ExporterApp:
             debug_vars=self._debug_vars,
             health_max_age_s=max(10.0 * cfg.interval_s, 10.0),
             max_concurrent_scrapes=cfg.max_concurrent_scrapes,
+            max_scrapes_per_s=cfg.max_scrapes_per_s,
         )
 
     def _debug_vars(self) -> dict:

@@ -382,8 +382,25 @@ class LibtpuMetricsBackend(DeviceBackend):
         # usage response: a device the runtime omits from one metric but
         # serves in another must still exist (chip_info presence, the
         # series that WERE read) — vanishing silently would undercount
-        # chips/hosts_reporting downstream (code-review r5).
-        devices = set(usage) | set(total) | set(duty) | set(ici)
+        # chips/hosts_reporting downstream (code-review r5). But the HBM
+        # axes are authoritative: a junk key from the optional responses
+        # (a mis-parsed link id, an empty attribute) must not fabricate a
+        # phantom chip or flip every real chip's id scheme to positional,
+        # so when the HBM devices are all-numeric, non-numeric duty/ICI
+        # extras are dropped with a partial error instead of enumerated.
+        devices = set(usage) | set(total)
+        devices.discard("")
+        aux = (set(duty) | set(ici)) - devices
+        aux.discard("")
+        if devices and all(d.isdigit() for d in devices):
+            junk = sorted(d for d in aux if not d.isdigit())
+            if junk:
+                partial.append(
+                    "ignoring non-numeric device key(s) in duty/ICI "
+                    "responses: " + ",".join(junk)
+                )
+                aux.difference_update(junk)
+        devices |= aux
         ordered = sorted(devices, key=_dev_sort_key)
         # A device absent from the usage (or total) response gets None for
         # that field (series omitted), NOT 0.0 — a zero we didn't read is a

@@ -43,6 +43,11 @@ class ExporterConfig:
     # /metrics concurrency cap: excess scrapers queue briefly then get 429
     # (0 disables). Protects the TPU host's cores from scrape storms.
     max_concurrent_scrapes: int = 4
+    # /metrics rate cap (token bucket, burst 2×; 0 disables): each full-body
+    # scrape at 256 chips costs ~0.4 ms of pure kernel-copy CPU, so a storm
+    # of them must be refused, not served. 100/s is ~20× any sane setup
+    # (a few Prometheus replicas + an aggregator at 1 Hz).
+    max_scrapes_per_s: float = 100.0
     process_metrics: bool = False  # procfs scan: which host pids hold which chips
     proc_root: str = "/proc"       # injectable for tests / sidecar mounts
     process_full_scan_every: int = 10  # polls between full /proc walks

@@ -222,7 +222,7 @@ TPU_EXPORTER_RSS_BYTES = MetricSpec(
 
 TPU_EXPORTER_SCRAPE_REJECTS_TOTAL = MetricSpec(
     name="tpu_exporter_scrape_rejects_total",
-    help="Scrapes rejected with 429 by the /metrics concurrency guard since start.",
+    help="Scrapes rejected with 429 by the /metrics concurrency guard or rate cap since start.",
     type=COUNTER,
 )
 

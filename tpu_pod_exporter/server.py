@@ -80,6 +80,42 @@ def accepts_openmetrics(accept: str) -> bool:
     return q_om > 0.0 and q_om >= q_text
 
 
+class _TokenBucket:
+    """Scrape-rate cap for /metrics. The concurrency semaphore bounds how
+    many big bodies are in flight, but not how many per second — and a
+    sequential storm of full-body scrapes is pure kernel-copy cost
+    (~0.4 ms CPU per ~950 KB body at 256 chips; measured, bench.py) that
+    no amount of server cleverness removes. Above the bucket rate the
+    exporter answers with the pre-rendered 429 instead: monitoring losing
+    a scrape beats monitoring stealing the TPU host's cores. The default
+    rate (config.max_scrapes_per_s=100) is ~20× any sane setup — a few
+    Prometheus replicas plus an aggregator at 1 Hz."""
+
+    __slots__ = ("rate", "burst", "tokens", "last", "lock")
+
+    def __init__(self, rate: float, burst: float) -> None:
+        self.rate = rate
+        self.burst = burst
+        self.tokens = burst
+        self.last = time.monotonic()
+        self.lock = threading.Lock()
+
+    def take(self) -> bool:
+        with self.lock:
+            # monotonic() read INSIDE the lock: a stale `now` against a
+            # newer `last` written by another thread would apply a negative
+            # refill, silently draining tokens (code-review r5).
+            now = time.monotonic()
+            self.tokens = min(
+                self.burst, self.tokens + (now - self.last) * self.rate
+            )
+            self.last = now
+            if self.tokens >= 1.0:
+                self.tokens -= 1.0
+                return True
+            return False
+
+
 class _Handler(BaseHTTPRequestHandler):
     # set by server factory
     store: SnapshotStore
@@ -96,6 +132,16 @@ class _Handler(BaseHTTPRequestHandler):
     # a scrape beats monitoring stealing the TPU host's CPU.
     scrape_sem: threading.BoundedSemaphore | None = None
     scrape_queue_timeout_s: float = 0.25
+    scrape_bucket: _TokenBucket | None = None
+    # Rate-cap rejects sleep this long before answering: a fast 429 just
+    # makes a storming client retry faster (measured: a sequential storm
+    # against an instant reject still ate >30% of a core in connection
+    # churn alone), while a tarpitted one is throttled to ~10 attempts/s
+    # per connection. Sleeping threads cost memory, not CPU; the slot cap
+    # below keeps a massively-concurrent flood from parking unbounded
+    # threads (overflow rejects immediately).
+    scrape_tarpit_s: float = 0.1
+    tarpit_slots: threading.BoundedSemaphore | None = None
     scrape_rejects = None  # [int] mutable cell, shared per server
     scrape_rejects_lock: threading.Lock | None = None
     protocol_version = "HTTP/1.1"
@@ -144,23 +190,39 @@ class _Handler(BaseHTTPRequestHandler):
             self._serve_text(404, b"not found\n")
 
     def _serve_metrics(self) -> None:
+        bucket = self.scrape_bucket
+        if bucket is not None and not bucket.take():
+            self._reject_scrape(tarpit=True)
+            return
         sem = self.scrape_sem
         if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
-            if self.scrape_rejects is not None:
-                # += on a list cell is a read-modify-write, NOT GIL-atomic;
-                # under the very storm this counts, unlocked increments drop
-                # (advisor r4). The reject path is already slow-path — a
-                # lock costs nothing here.
-                with self.scrape_rejects_lock:
-                    self.scrape_rejects[0] += 1
-            self.close_connection = True
-            self.wfile.write(_REJECT_RESPONSE)
+            # No tarpit here: this path already queued for
+            # scrape_queue_timeout_s, which throttles the client the same way.
+            self._reject_scrape()
             return
         try:
             self._serve_metrics_inner()
         finally:
             if sem is not None:
                 sem.release()
+
+    def _reject_scrape(self, tarpit: bool = False) -> None:
+        if tarpit and self.scrape_tarpit_s > 0:
+            slots = self.tarpit_slots
+            if slots is not None and slots.acquire(blocking=False):
+                try:
+                    time.sleep(self.scrape_tarpit_s)
+                finally:
+                    slots.release()
+        if self.scrape_rejects is not None:
+            # += on a list cell is a read-modify-write, NOT GIL-atomic;
+            # under the very storm this counts, unlocked increments drop
+            # (advisor r4). The reject path is already slow-path — a
+            # lock costs nothing here.
+            with self.scrape_rejects_lock:
+                self.scrape_rejects[0] += 1
+        self.close_connection = True
+        self.wfile.write(_REJECT_RESPONSE)
 
     def _serve_metrics_inner(self) -> None:
         snap = self.store.current()
@@ -218,6 +280,8 @@ class MetricsServer:
         health_max_age_s: float = 0.0,
         max_concurrent_scrapes: int = 4,
         scrape_queue_timeout_s: float = 0.25,
+        max_scrapes_per_s: float = 0.0,
+        scrape_tarpit_s: float = 0.1,
     ) -> None:
         self.scrape_rejects = [0]
         handler = type(
@@ -233,6 +297,16 @@ class MetricsServer:
                     else None
                 ),
                 "scrape_queue_timeout_s": scrape_queue_timeout_s,
+                # Burst 2× rate: absorbs scrape-alignment spikes (every
+                # scraper firing in the same second) without letting a
+                # sustained storm exceed ~rate serves/s.
+                "scrape_bucket": (
+                    _TokenBucket(max_scrapes_per_s, 2.0 * max_scrapes_per_s)
+                    if max_scrapes_per_s > 0
+                    else None
+                ),
+                "scrape_tarpit_s": scrape_tarpit_s,
+                "tarpit_slots": threading.BoundedSemaphore(64),
                 "scrape_rejects": self.scrape_rejects,
                 "scrape_rejects_lock": threading.Lock(),
             },
