@@ -227,6 +227,23 @@ class TestLibtpuBackend:
         assert any("non-numeric device key" in e for e in sample.partial_errors)
         backend.close()
 
+    def test_empty_device_key_dropped_with_partial_error(self, metric_server):
+        # An attribute-less row has no identity to publish under; it is
+        # dropped but must be ACCOUNTED (code-review r5: silent drop =
+        # silent undercount).
+        service, addr = metric_server
+        resp = metric_response([(0, GIB)])
+        m = resp.metric.metrics.add()  # row with no attributes at all
+        m.gauge.as_int = 7
+        service.tables[HBM_USAGE] = resp
+        service.set(HBM_TOTAL, [(0, 32 * GIB)])
+        service.set(DUTY_CYCLE, [(0, 1.0)])
+        backend = LibtpuMetricsBackend(addr=addr, device_paths={})
+        sample = backend.sample()
+        assert [c.info.chip_id for c in sample.chips] == [0]
+        assert any("empty device key" in e for e in sample.partial_errors)
+        backend.close()
+
     def test_duty_only_device_still_enumerates(self, metric_server):
         service, addr = metric_server
         service.set(HBM_USAGE, [(0, GIB)])

@@ -104,11 +104,17 @@ class TestChurn:
     def test_series_count_bounded_under_churn(self, churn_app):
         app, attr = churn_app
         # Warm up past the startup snapshot: ICI bandwidth series exist only
-        # from the second sampled poll (a rate needs a dt window), so a
-        # scrape racing the first poll would skew the count by 32 series.
+        # from the second sampled poll (a rate needs a dt window), and the
+        # scrape-duration histogram's series exist only once a poll AFTER
+        # the first scrape emits its observation — either appearing
+        # mid-loop would skew the count (by 32 and 14 series respectively).
         deadline = time.time() + 5
         while time.time() < deadline:
-            if "tpu_ici_link_bandwidth_bytes_per_second{" in scrape(app.port):
+            text = scrape(app.port)
+            if (
+                "tpu_ici_link_bandwidth_bytes_per_second{" in text
+                and "tpu_exporter_scrape_duration_seconds_count" in text
+            ):
                 break
             time.sleep(0.01)
         counts = []

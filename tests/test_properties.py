@@ -202,3 +202,54 @@ class TestFastBlockParseEquivalence:
                 f"fast path accepted a block the regex rejects: {block!r}"
             ) from None
         assert got == want
+
+
+class TestHistogramInvariants:
+    """Histogram exposition invariants for ANY observation sequence:
+    buckets cumulative non-decreasing, +Inf bucket == _count, _sum == the
+    float sum, and the strict OpenMetrics parser accepts the output."""
+
+    # Non-negative domain: strict OpenMetrics forbids a histogram _sum with
+    # negative buckets or observations, and every histogram this exporter
+    # defines is a duration (>= 0 by construction).
+    @given(
+        observations=st.lists(
+            st.floats(min_value=0.0, max_value=1e6, allow_nan=False),
+            min_size=1, max_size=100,
+        )
+    )
+    @settings(max_examples=100, deadline=None)
+    def test_histogram_invariants_hold(self, observations):
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families as om_parse,
+        )
+
+        from tpu_pod_exporter.metrics.registry import (
+            HistogramSpec,
+            HistogramStore,
+        )
+
+        spec = HistogramSpec(
+            name="h", help="h", buckets=(0.0, 0.5, 100.0)
+        )
+        store = HistogramStore(spec)
+        for v in observations:
+            store.observe(v)
+        b = SnapshotBuilder()
+        store.emit(b)
+        om = b.build(timestamp=1.0).encode_openmetrics().decode()
+        fams = {f.name: f for f in om_parse(om)}
+        fam = fams["h"]
+        assert fam.type == "histogram"
+        buckets = [s for s in fam.samples if s.name == "h_bucket"]
+        counts = [s.value for s in buckets]
+        assert counts == sorted(counts)  # cumulative, non-decreasing
+        count = next(s.value for s in fam.samples if s.name == "h_count")
+        assert buckets[-1].labels["le"] == "+Inf"
+        assert buckets[-1].value == count == len(observations)
+        total = next(s.value for s in fam.samples if s.name == "h_sum")
+        assert math.isclose(total, math.fsum(observations), rel_tol=1e-9, abs_tol=1e-6)
+        # Exact bucket math, recomputed independently: each le bucket holds
+        # the number of observations <= bound.
+        for s, bound in zip(buckets[:-1], spec.buckets):
+            assert s.value == sum(1 for v in observations if v <= bound)

@@ -8,6 +8,8 @@ from prometheus_client.parser import text_string_to_metric_families
 from tpu_pod_exporter.metrics.registry import (
     COUNTER,
     CounterStore,
+    HistogramSpec,
+    HistogramStore,
     MetricSpec,
     SnapshotBuilder,
     SnapshotStore,
@@ -39,7 +41,7 @@ class TestMetricSpec:
 
     def test_bad_type(self):
         with pytest.raises(ValueError):
-            MetricSpec(name="ok", help="h", type="histogram")
+            MetricSpec(name="ok", help="h", type="summary")
 
 
 class TestFormatting:
@@ -223,3 +225,140 @@ class TestCounterStore:
         c.inc("n", ("a",))
         c.inc("m", ("b",))
         assert c.items_for("n") == [(("a",), 1.0)]
+
+
+HIST = HistogramSpec(
+    name="test_duration_seconds",
+    help="a histogram",
+    buckets=(0.1, 1.0, 10.0),
+    label_names=("phase",),
+)
+
+
+class TestHistogramSpec:
+    def test_bad_buckets(self):
+        with pytest.raises(ValueError):
+            HistogramSpec(name="h", help="h", buckets=())
+        with pytest.raises(ValueError):
+            HistogramSpec(name="h", help="h", buckets=(1.0, 1.0))
+        with pytest.raises(ValueError):
+            HistogramSpec(name="h", help="h", buckets=(2.0, 1.0))
+        with pytest.raises(ValueError):
+            HistogramSpec(name="h", help="h", buckets=(1.0, math.inf))
+
+    def test_le_label_reserved(self):
+        with pytest.raises(ValueError):
+            HistogramSpec(name="h", help="h", buckets=(1.0,), label_names=("le",))
+
+    def test_le_values_include_inf(self):
+        assert HIST.le_values == ("0.1", "1", "10", "+Inf")
+
+
+def _render(store):
+    b = SnapshotBuilder()
+    store.emit(b)
+    return b.build(timestamp=1.0).encode().decode()
+
+
+class TestHistogramStore:
+    def test_observe_and_emit_exact(self):
+        s = HistogramStore(HIST)
+        for v in (0.05, 0.1, 5.0, 100.0):  # 0.1 lands IN le="0.1" (le = <=)
+            s.observe(v, ("total",))
+        text = _render(s)
+        want = [
+            'test_duration_seconds_bucket{phase="total",le="0.1"} 2',
+            'test_duration_seconds_bucket{phase="total",le="1"} 2',
+            'test_duration_seconds_bucket{phase="total",le="10"} 3',
+            'test_duration_seconds_bucket{phase="total",le="+Inf"} 4',
+            'test_duration_seconds_count{phase="total"} 4',
+            'test_duration_seconds_sum{phase="total"} 105.15',
+        ]
+        body = [l for l in text.splitlines() if not l.startswith("#")]
+        assert body == want
+        assert "# TYPE test_duration_seconds histogram" in text
+        # The internal raw-lines family name must never leak into output.
+        assert "_lines" not in text
+
+    def test_openmetrics_strict_parser_and_per_labelset_grouping(self):
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families as om_parse,
+        )
+
+        s = HistogramStore(HIST)
+        s.observe(0.5, ("a",))
+        s.observe(2.0, ("b",))
+        s.observe(0.01, ("a",))
+        b = SnapshotBuilder()
+        s.emit(b)
+        om = b.build(timestamp=1.0).encode_openmetrics().decode()
+        fams = {f.name: f for f in om_parse(om)}
+        fam = fams["test_duration_seconds"]
+        assert fam.type == "histogram"
+        by_name = {}
+        for sample in fam.samples:
+            by_name.setdefault(sample.name, []).append(sample)
+        assert len(by_name["test_duration_seconds_bucket"]) == 8  # 2 sets x 4
+        a_inf = [
+            x for x in by_name["test_duration_seconds_bucket"]
+            if x.labels == {"phase": "a", "le": "+Inf"}
+        ]
+        assert a_inf[0].value == 2.0
+
+    def test_cumulative_across_emits(self):
+        s = HistogramStore(HIST)
+        s.observe(0.5)
+        _render(s)
+        s.observe(0.6)
+        text = _render(s)
+        assert "test_duration_seconds_count 2" in text
+
+    def test_unlabeled_histogram_renders_bare_names(self):
+        s = HistogramStore(HistogramSpec(name="h2", help="h", buckets=(1.0,)))
+        s.observe(0.5)
+        text = _render(s)
+        assert 'h2_bucket{le="1"} 1' in text
+        assert "h2_count 1" in text
+        assert "h2_sum 0.5" in text
+
+    def test_empty_store_emits_headers_only(self):
+        text = _render(HistogramStore(HIST))
+        assert "# TYPE test_duration_seconds histogram" in text
+        assert "_bucket" not in text
+
+    def test_thread_hammer_loses_no_observations(self):
+        import threading
+
+        s = HistogramStore(HIST)
+        n_threads, per = 8, 1000
+
+        def work():
+            for i in range(per):
+                s.observe(i % 20, ("t",))
+
+        threads = [threading.Thread(target=work) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        text = _render(s)
+        assert f'test_duration_seconds_count{{phase="t"}} {n_threads * per}' in text
+
+    def test_identical_output_with_and_without_prefix_cache(self):
+        from tpu_pod_exporter.metrics.registry import PrefixCache
+
+        s = HistogramStore(HIST)
+        for v in (0.05, 0.7, 3.0, 50.0):
+            s.observe(v, ("x",))
+        cache = PrefixCache()
+        b1 = SnapshotBuilder(prefix_cache=cache)
+        s.emit(b1)
+        cached_text = b1.build(timestamp=1.0).encode()
+        b2 = SnapshotBuilder()
+        s.emit(b2)
+        plain_text = b2.build(timestamp=1.0).encode()
+        assert cached_text == plain_text
+        # Second emit through the same cache (layout fast path) agrees too.
+        b3 = SnapshotBuilder(prefix_cache=cache)
+        s.emit(b3)
+        assert b3.build(timestamp=1.0).encode() == plain_text

@@ -235,6 +235,59 @@ class TestOpenMetrics:
         assert "tpu_hbm_used_bytes" in fams
         samples = fams["tpu_ici_transferred_bytes"].samples
         assert all(s.name == "tpu_ici_transferred_bytes_total" for s in samples)
+        # The poll-phase histogram must be a strict-OM-valid histogram family.
+        hist = fams["tpu_exporter_poll_phase_duration_seconds"]
+        assert hist.type == "histogram"
+        counts = {
+            s.labels["phase"]: s.value
+            for s in hist.samples
+            if s.name.endswith("_count")
+        }
+        # Observations land at poll END, so the snapshot published during
+        # poll 2 carries exactly poll 1's observation.
+        assert counts["total"] == 1.0
+
+
+def test_scrape_duration_histogram_reaches_exposition():
+    """Handler threads observe; the collector emits on the next poll —
+    end-to-end through a real ExporterApp."""
+    from tpu_pod_exporter.app import ExporterApp
+    from tpu_pod_exporter.attribution.fake import FakeAttribution
+    from tpu_pod_exporter.backend.fake import FakeBackend
+    from tpu_pod_exporter.config import ExporterConfig
+
+    app = ExporterApp(
+        ExporterConfig(port=0, host="127.0.0.1", interval_s=30.0,
+                       backend="fake", fake_chips=1, attribution="none"),
+        backend=FakeBackend(chips=1), attribution=FakeAttribution(),
+    )
+    app.start()
+    try:
+        import time
+
+        base = f"http://127.0.0.1:{app.port}"
+        for _ in range(3):
+            get(base + "/metrics")
+        # The observer runs on the handler thread just after the body write,
+        # so the client can be back here before the observation lands —
+        # poll-and-retry instead of assuming ordering.
+        deadline = time.monotonic() + 5.0
+        count = -1.0
+        while time.monotonic() < deadline:
+            app.collector.poll_once()
+            body = get(base + "/metrics")[2].decode()
+            lines = [
+                l for l in body.splitlines()
+                if l.startswith("tpu_exporter_scrape_duration_seconds_count")
+            ]
+            count = float(lines[0].split()[-1]) if lines else -1.0
+            if count >= 3:
+                break
+            time.sleep(0.05)
+        assert count >= 3
+        assert "# TYPE tpu_exporter_scrape_duration_seconds histogram" in body
+    finally:
+        app.stop()
 
 
 class TestAcceptParsing:
@@ -483,6 +536,41 @@ class TestScrapeRateCap:
             assert status == 429
             assert elapsed >= 0.15
         finally:
+            server.stop()
+
+    def test_concurrency_reject_refunds_rate_token(self):
+        # Code-review r5: a scrape refused by the concurrency guard was
+        # never served, so it must not count against the rate — a stall
+        # would otherwise drain the bucket and 429 well-behaved scrapers
+        # after it clears.
+        import threading
+
+        release = threading.Semaphore(0)
+        entered = threading.Semaphore(0)
+        store = TestScrapeConcurrencyGuard()._blocking_store(release, entered)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0,
+            max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05,
+            max_scrapes_per_s=5.0, scrape_tarpit_s=0.0,
+        )
+        server.start()
+        base = f"http://127.0.0.1:{server.port}"
+        try:
+            holder = threading.Thread(target=lambda: get(base + "/metrics"))
+            holder.start()
+            assert entered.acquire(timeout=5)
+            # 8 sem-rejects; each took then refunded a token (burst is 10,
+            # and the holder itself consumed 1).
+            for _ in range(8):
+                assert get(base + "/metrics")[0] == 429
+            release.release(16)
+            entered.release(16)
+            holder.join(timeout=5)
+            # Bucket must still hold ~9 tokens: 8 quick scrapes all serve.
+            statuses = [get(base + "/metrics")[0] for _ in range(8)]
+            assert statuses == [200] * 8
+        finally:
+            release.release(16)
             server.stop()
 
     def test_rate_cap_disabled_by_default(self):

@@ -19,7 +19,8 @@ from tpu_pod_exporter.backend import DeviceBackend
 from tpu_pod_exporter.backend.fake import FakeBackend
 from tpu_pod_exporter.collector import Collector, CollectorLoop
 from tpu_pod_exporter.config import ExporterConfig
-from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.metrics import HistogramStore, SnapshotStore
+from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.server import MetricsServer
 from tpu_pod_exporter.topology import detect_host_topology
 
@@ -193,6 +194,10 @@ class ExporterApp:
                 full_scan_every=cfg.process_full_scan_every,
             )
         self.process_scanner = scanner
+        # Scrape-latency distribution: handler threads observe, the
+        # collector emits it into each snapshot (one poll behind, which is
+        # fine for a cumulative histogram).
+        scrape_hist = HistogramStore(schema.TPU_EXPORTER_SCRAPE_DURATION_HIST)
         self.collector = Collector(
             backend=self.backend,
             attribution=self.attribution,
@@ -205,6 +210,7 @@ class ExporterApp:
             # Deferred attribute read: self.server is constructed below;
             # the first poll (in start()) runs after __init__ completes.
             scrape_rejects_fn=lambda: self.server.scrape_rejects[0],
+            scrape_duration_hist=scrape_hist,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -218,6 +224,7 @@ class ExporterApp:
             health_max_age_s=max(10.0 * cfg.interval_s, 10.0),
             max_concurrent_scrapes=cfg.max_concurrent_scrapes,
             max_scrapes_per_s=cfg.max_scrapes_per_s,
+            scrape_observer=scrape_hist.observe,
         )
 
     def _debug_vars(self) -> dict:

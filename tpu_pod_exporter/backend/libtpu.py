@@ -389,9 +389,14 @@ class LibtpuMetricsBackend(DeviceBackend):
         # so when the HBM devices are all-numeric, non-numeric duty/ICI
         # extras are dropped with a partial error instead of enumerated.
         devices = set(usage) | set(total)
-        devices.discard("")
         aux = (set(duty) | set(ici)) - devices
-        aux.discard("")
+        if "" in devices or "" in aux:
+            # An attribute-less row has no device identity to publish under;
+            # dropping it silently would be the same unaccounted undercount
+            # as the non-numeric junk below — record it.
+            partial.append("dropping metric row(s) with empty device key")
+            devices.discard("")
+            aux.discard("")
         if devices and all(d.isdigit() for d in devices):
             junk = sorted(d for d in aux if not d.isdigit())
             if junk:

@@ -1,7 +1,10 @@
 from tpu_pod_exporter.metrics.registry import (
     COUNTER,
     GAUGE,
+    HISTOGRAM,
     CounterStore,
+    HistogramSpec,
+    HistogramStore,
     MetricSpec,
     Snapshot,
     SnapshotBuilder,
@@ -11,7 +14,10 @@ from tpu_pod_exporter.metrics.registry import (
 __all__ = [
     "COUNTER",
     "GAUGE",
+    "HISTOGRAM",
     "CounterStore",
+    "HistogramSpec",
+    "HistogramStore",
     "MetricSpec",
     "Snapshot",
     "SnapshotBuilder",

@@ -28,13 +28,15 @@ import math
 import threading
 import time
 from array import array
+from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Sequence
 
 GAUGE = "gauge"
 COUNTER = "counter"
+HISTOGRAM = "histogram"
 
-_VALID_TYPES = (GAUGE, COUNTER)
+_VALID_TYPES = (GAUGE, COUNTER, HISTOGRAM)
 
 
 @dataclass(frozen=True)
@@ -50,6 +52,19 @@ class MetricSpec:
     help: str
     type: str = GAUGE
     label_names: tuple[str, ...] = ()
+    # Histogram child families (_bucket/_sum/_count) render their sample
+    # lines under the PARENT family's single `# TYPE <name> histogram`
+    # header, so their own HELP/TYPE lines are suppressed. Everything else
+    # about them (layout cache, native render, value formatting) is the
+    # ordinary family machinery — that is the point of this representation.
+    suppress_header: bool = False
+    # Raw-lines family: each sample's label "tuple" is a 1-tuple holding the
+    # FULLY pre-rendered series prefix (``name_bucket{phase="x",le="0.1"}``).
+    # This is what lets one family carry a histogram's _bucket/_count/_sum
+    # lines in the per-label-set order OpenMetrics requires (MetricPoints of
+    # one label set must be contiguous) while still riding the FamilyLayout
+    # and native render paths, which only ever see opaque prefix bytes.
+    raw_lines: bool = False
 
     def __post_init__(self) -> None:
         if self.type not in _VALID_TYPES:
@@ -117,6 +132,8 @@ class _Family:
 def render_prefix(spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
     """The `metric{label="…"}` part of one exposition line — the single
     source of truth for both the cached and uncached render paths."""
+    if spec.raw_lines:
+        return lvs[0].encode()
     if not spec.label_names and not lvs:
         return spec.name.encode()
     if len(lvs) != len(spec.label_names):
@@ -364,10 +381,11 @@ class Snapshot:
         chunks: list[bytes] = []
         for fam in self._families.values():
             spec = fam.spec
-            chunks.append(
-                f"# HELP {spec.name} {escape_help(spec.help)}\n"
-                f"# TYPE {spec.name} {spec.type}\n".encode()
-            )
+            if not spec.suppress_header:
+                chunks.append(
+                    f"# HELP {spec.name} {escape_help(spec.help)}\n"
+                    f"# TYPE {spec.name} {spec.type}\n".encode()
+                )
             if not fam.samples:
                 continue
             if cache is not None:
@@ -489,6 +507,144 @@ class SnapshotStore:
     def current(self) -> Snapshot:
         with self._lock:
             return self._snapshot
+
+
+class HistogramSpec:
+    """One histogram family: a header-only parent spec (``TYPE histogram``)
+    plus a single raw-lines child family carrying every ``_bucket`` /
+    ``_count`` / ``_sum`` sample in OpenMetrics order.
+
+    Exposition shape (Prometheus text format / OpenMetrics 1.0)::
+
+        # HELP name help
+        # TYPE name histogram
+        name_bucket{...,le="0.005"} 3
+        ...
+        name_bucket{...,le="+Inf"} 9
+        name_count{...} 9
+        name_sum{...} 0.123
+
+    One raw-lines family (not three suffix families) because OpenMetrics
+    requires a label set's MetricPoints to be contiguous — bucket/count/sum
+    must interleave PER LABEL SET, which per-suffix family blocks cannot
+    express. The child's samples still ride the existing fast paths
+    (FamilyLayout, native renderer) untouched: those only ever see opaque
+    prefix bytes. ``buckets`` are finite upper bounds, strictly increasing;
+    the ``+Inf`` bucket is implicit (always emitted, equal to ``_count``).
+    Strict OpenMetrics additionally forbids ``_sum`` alongside negative
+    buckets or observations — every histogram here is a duration, so keep
+    bounds and observed values non-negative.
+    """
+
+    __slots__ = ("parent", "lines", "label_names", "buckets", "le_values")
+
+    def __init__(
+        self,
+        name: str,
+        help: str,  # noqa: A002 — mirrors MetricSpec
+        buckets: Sequence[float],
+        label_names: tuple[str, ...] = (),
+    ) -> None:
+        bs = tuple(float(b) for b in buckets)
+        if not bs:
+            raise ValueError(f"{name}: histogram needs at least one bucket")
+        if any(math.isinf(b) or math.isnan(b) for b in bs):
+            raise ValueError(f"{name}: buckets must be finite (+Inf is implicit)")
+        if any(b2 <= b1 for b1, b2 in zip(bs, bs[1:])):
+            raise ValueError(f"{name}: buckets must be strictly increasing")
+        if "le" in label_names:
+            raise ValueError(f"{name}: 'le' is reserved for the bucket label")
+        self.label_names = tuple(label_names)
+        self.buckets = bs
+        self.le_values = tuple(format_value(b) for b in bs) + ("+Inf",)
+        self.parent = MetricSpec(
+            name=name, help=help, type=HISTOGRAM, label_names=self.label_names
+        )
+        # "_lines" is an internal family key, never rendered (header
+        # suppressed, prefixes pre-rendered) — it cannot collide with a real
+        # exposition name.
+        self.lines = MetricSpec(
+            name=name + "_lines", help=help, type=GAUGE,
+            label_names=("line",), suppress_header=True, raw_lines=True,
+        )
+
+
+class HistogramStore:
+    """Observation state for one histogram family, accumulated across polls.
+
+    Like :class:`CounterStore`, state outlives individual snapshots: the
+    snapshot model rebuilds every series each poll, so distributions must
+    live with an owner. ``observe`` is safe from any thread (scrape handler
+    threads observe while the poll thread emits) and cheap enough for the
+    scrape path: a bisect plus three adds under a lock.
+    """
+
+    def __init__(self, spec: HistogramSpec) -> None:
+        self.spec = spec
+        self._lock = threading.Lock()
+        # label values tuple -> [per-bucket counts (non-cumulative,
+        # +Inf last), sum, count]
+        self._data: dict[tuple[str, ...], list] = {}
+        # label values tuple -> (bucket key-tuples, count key, sum key):
+        # the fully rendered series prefixes, built once per label set.
+        # Reusing the same key-tuple OBJECTS every emit keeps the
+        # FamilyLayout comparison on its fast path.
+        self._line_keys: dict[tuple[str, ...], tuple] = {}
+
+    def observe(self, value: float, labels: tuple[str, ...] = ()) -> None:
+        idx = bisect_left(self.spec.buckets, value)  # le: value == bound counts
+        with self._lock:
+            rec = self._data.get(labels)
+            if rec is None:
+                rec = self._data[labels] = [
+                    [0] * (len(self.spec.buckets) + 1), 0.0, 0,
+                ]
+            rec[0][idx] += 1
+            rec[1] += value
+            rec[2] += 1
+
+    def _keys_for(self, lvs: tuple[str, ...]) -> tuple:
+        cached = self._line_keys.get(lvs)
+        if cached is not None:
+            return cached
+        spec = self.spec
+        name = spec.parent.name
+        base = ",".join(
+            f'{ln}="{escape_label_value(v)}"'
+            for ln, v in zip(spec.label_names, lvs)
+        )
+        sep = base + "," if base else ""
+        bucket_keys = tuple(
+            (f'{name}_bucket{{{sep}le="{le}"}}',) for le in spec.le_values
+        )
+        count_key = (f"{name}_count{{{base}}}" if base else f"{name}_count",)
+        sum_key = (f"{name}_sum{{{base}}}" if base else f"{name}_sum",)
+        cached = (bucket_keys, count_key, sum_key)
+        self._line_keys[lvs] = cached
+        return cached
+
+    def emit(self, builder: "SnapshotBuilder") -> None:
+        """Declare parent + lines families (adjacent, so the sample lines
+        sit under the parent's header) and add every label set's current
+        cumulative state in OpenMetrics order: per label set, buckets
+        ascending, then count, then sum."""
+        spec = self.spec
+        builder.declare(spec.parent)
+        builder.declare(spec.lines)
+        with self._lock:
+            snap = {
+                lvs: (list(rec[0]), rec[1], rec[2])
+                for lvs, rec in self._data.items()
+            }
+        lines_s = builder.series(spec.lines)
+        for lvs, (counts, total, n) in snap.items():
+            bucket_keys, count_key, sum_key = self._keys_for(lvs)
+            cum = 0
+            for key, c in zip(bucket_keys, counts):
+                cum += c
+                lines_s[key] = float(cum)
+            lines_s[count_key] = float(n)
+            lines_s[sum_key] = total
 
 
 class CounterStore:

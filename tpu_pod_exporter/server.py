@@ -115,6 +115,14 @@ class _TokenBucket:
                 return True
             return False
 
+    def refund(self) -> None:
+        """Return a taken token (the request was refused downstream anyway —
+        e.g. by the concurrency guard — so it must not count against the
+        rate: a stall would otherwise drain the bucket and 429 well-behaved
+        scrapers after it clears)."""
+        with self.lock:
+            self.tokens = min(self.burst, self.tokens + 1.0)
+
 
 class _Handler(BaseHTTPRequestHandler):
     # set by server factory
@@ -144,6 +152,11 @@ class _Handler(BaseHTTPRequestHandler):
     tarpit_slots: threading.BoundedSemaphore | None = None
     scrape_rejects = None  # [int] mutable cell, shared per server
     scrape_rejects_lock: threading.Lock | None = None
+    # Optional (duration_s: float) -> None, called for every SERVED scrape
+    # (rejects excluded — a tarpit sleep is not a scrape latency). Feeds the
+    # tpu_exporter_scrape_duration_seconds histogram; must stay cheap, it
+    # runs on the scrape path.
+    scrape_observer = None
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
@@ -196,12 +209,18 @@ class _Handler(BaseHTTPRequestHandler):
             return
         sem = self.scrape_sem
         if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
+            if bucket is not None:
+                bucket.refund()  # this scrape was never served
             # No tarpit here: this path already queued for
             # scrape_queue_timeout_s, which throttles the client the same way.
             self._reject_scrape()
             return
         try:
+            t0 = time.perf_counter()
             self._serve_metrics_inner()
+            observer = self.scrape_observer
+            if observer is not None:
+                observer(time.perf_counter() - t0)
         finally:
             if sem is not None:
                 sem.release()
@@ -282,6 +301,7 @@ class MetricsServer:
         scrape_queue_timeout_s: float = 0.25,
         max_scrapes_per_s: float = 0.0,
         scrape_tarpit_s: float = 0.1,
+        scrape_observer=None,
     ) -> None:
         self.scrape_rejects = [0]
         handler = type(
@@ -309,6 +329,9 @@ class MetricsServer:
                 "tarpit_slots": threading.BoundedSemaphore(64),
                 "scrape_rejects": self.scrape_rejects,
                 "scrape_rejects_lock": threading.Lock(),
+                "scrape_observer": (
+                    staticmethod(scrape_observer) if scrape_observer else None
+                ),
             },
         )
         self._httpd = _Server((host, port), handler)
