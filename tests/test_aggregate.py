@@ -631,6 +631,99 @@ class TestAggregateHonesty:
         )
 
 
+class TestMultisliceRollups:
+    """Cross-slice (multi-slice group) rollups joined via tpu_host_info
+    (BASELINE config 5: 2x v5p-128 over DCN)."""
+
+    def _host_text(self, slice_name, worker, group="ms-group-a", nslices="2"):
+        backend = FakeBackend(
+            chips=2,
+            script=FakeChipScript(
+                hbm_total_bytes=8 * GIB, hbm_used_bytes=GIB,
+                ici_link_count=2, ici_bytes_per_step=1000.0,
+                dcn_link_count=1, dcn_bytes_per_step=500.0,
+            ),
+        )
+        topo = HostTopology(
+            accelerator="v5p-128", slice_name=slice_name,
+            host=f"{slice_name}-h{worker}", worker_id=str(worker),
+            multislice_group=group, num_slices=nslices,
+        )
+        store = SnapshotStore()
+        c = Collector(backend, FakeAttribution(), store, topology=topo)
+        c.poll_once()
+        c.poll_once()  # second poll so ICI/DCN rates exist
+        return store.current().encode().decode()
+
+    def _aggregate(self, pages):
+        store = SnapshotStore()
+        agg = SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages))
+        agg.poll_once()
+        agg.close()
+        return store.current()
+
+    def test_two_slices_roll_up_into_their_group(self):
+        pages = {
+            f"{s}h{w}:8000": self._host_text(s, w)
+            for s in ("s0", "s1") for w in (0, 1)
+        }
+        snap = self._aggregate(pages)
+        g = {"multislice_group": "ms-group-a"}
+        assert snap.value("tpu_multislice_slices_reporting", g) == 2.0
+        assert snap.value("tpu_multislice_expected_slices", g) == 2.0
+        assert snap.value("tpu_multislice_hosts_reporting", g) == 4.0
+        assert snap.value("tpu_multislice_chip_count", g) == 8.0
+        assert snap.value("tpu_multislice_hbm_used_bytes", g) == 8 * GIB
+        assert snap.value("tpu_multislice_ici_bytes_per_second", g) > 0
+        assert snap.value("tpu_multislice_dcn_bytes_per_second", g) > 0
+        # The per-slice DCN rollup exists alongside the group one.
+        skey = {"slice_name": "s0", "accelerator": "v5p-128"}
+        assert snap.value("tpu_slice_dcn_bytes_per_second", skey) > 0
+
+    def test_missing_slice_shows_in_reporting_vs_expected(self):
+        # Only slice s0 scrapes; expected_slices (from MEGASCALE_NUM_SLICES)
+        # stays 2 — the alertable gap for a slice that fell out.
+        pages = {f"s0h{w}:8000": self._host_text("s0", w) for w in (0, 1)}
+        snap = self._aggregate(pages)
+        g = {"multislice_group": "ms-group-a"}
+        assert snap.value("tpu_multislice_slices_reporting", g) == 1.0
+        assert snap.value("tpu_multislice_expected_slices", g) == 2.0
+
+    def test_two_groups_stay_separate(self):
+        pages = {
+            "a0:8000": self._host_text("s0", 0, group="group-a", nslices="1"),
+            "b0:8000": self._host_text("s1", 0, group="group-b", nslices="1"),
+        }
+        snap = self._aggregate(pages)
+        assert snap.value(
+            "tpu_multislice_chip_count", {"multislice_group": "group-a"}
+        ) == 2.0
+        assert snap.value(
+            "tpu_multislice_chip_count", {"multislice_group": "group-b"}
+        ) == 2.0
+
+    def test_single_slice_without_group_emits_no_group_series(self):
+        pages = {"h0:8000": make_host_text(0)}  # no multislice membership
+        snap = self._aggregate(pages)
+        text = snap.encode().decode()
+        assert "tpu_multislice_chip_count{" not in text
+        assert "tpu_multislice_slices_reporting{" not in text
+
+    def test_dcn_omitted_when_no_chip_reports_it(self):
+        # make_host_text chips have ICI but no DCN links: slice DCN and
+        # group DCN must be ABSENT, not 0.0.
+        pages = {
+            "h0:8000": self._host_text("s0", 0),
+        }
+        # Re-render without DCN by using the plain host text:
+        pages["h1:8000"] = make_host_text(1)
+        snap = self._aggregate(pages)
+        assert snap.value(
+            "tpu_slice_dcn_bytes_per_second",
+            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+        ) is None
+
+
 class TestAggregatorCli:
     def test_cli_end_to_end_with_sigterm_drain(self):
         """python -m tpu_pod_exporter.aggregate against a live exporter:

@@ -311,6 +311,62 @@ class TestIciRates:
         assert snap.value("tpu_ici_transferred_bytes_total", labels) == 1000.0
         assert snap.value("tpu_ici_link_bandwidth_bytes_per_second", labels) == 250.0
 
+    def test_dcn_counter_and_rate(self, store):
+        # DCN (cross-slice fabric) rides the same fold semantics as ICI:
+        # monotonic counter, rate only from the second sampled poll.
+        script = FakeChipScript(
+            ici_link_count=1, ici_bytes_per_step=500.0,
+            dcn_link_count=2, dcn_bytes_per_step=100.0,
+        )
+        backend = FakeBackend(chips=1, script=script)
+        fake_now = [0.0]
+        c = make_collector(backend, FakeAttribution(), store,
+                           clock=lambda: fake_now[0])
+        c.poll_once()
+        labels = {**chip_labels(0), "link": "dcn0"}
+        snap = store.current()
+        assert snap.value("tpu_dcn_transferred_bytes_total", labels) == 100.0
+        assert snap.value("tpu_dcn_link_bandwidth_bytes_per_second", labels) is None
+        fake_now[0] += 2.0
+        c.poll_once()
+        snap = store.current()
+        assert snap.value("tpu_dcn_transferred_bytes_total", labels) == 200.0
+        assert snap.value("tpu_dcn_link_bandwidth_bytes_per_second", labels) == 50.0
+        # ICI and DCN coexist without cross-talk.
+        assert snap.value(
+            "tpu_ici_transferred_bytes_total", {**chip_labels(0), "link": "0"}
+        ) == 1000.0
+
+    def test_no_dcn_series_without_dcn_links(self, store, four_chip_backend):
+        c = make_collector(four_chip_backend, FakeAttribution(), store)
+        c.poll_once()
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "tpu_dcn_transferred_bytes_total{" not in text
+
+    def test_dcn_counter_monotonic_across_device_reset(self, store):
+        steps = iter([1000.0, 2000.0, 50.0, 150.0])  # reset after poll 2
+
+        class ResettingScript(FakeChipScript):
+            def sample(self, info, step, link_cache=None):
+                s = super().sample(info, step, link_cache)
+                total = next(steps)
+                from tpu_pod_exporter.backend import IciLinkSample
+                return s._replace(
+                    dcn_links=(IciLinkSample("dcn0", total),)
+                )
+
+        backend = FakeBackend(chips=1, script=ResettingScript())
+        c = make_collector(backend, FakeAttribution(), store)
+        labels = {**chip_labels(0), "link": "dcn0"}
+        vals = []
+        for _ in range(4):
+            c.poll_once()
+            vals.append(
+                store.current().value("tpu_dcn_transferred_bytes_total", labels)
+            )
+        assert vals == [1000.0, 2000.0, 2000.0, 2100.0]  # holds over the reset
+
     def test_counter_state_survives_failed_poll(self, store):
         """A transient device-read failure must not wipe ICI counter state —
         otherwise the exported counter regresses to the raw value on

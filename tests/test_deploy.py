@@ -21,11 +21,18 @@ NON_METRIC_TOKENS = {"tpu_pod_exporter"}
 
 
 def schema_metric_names() -> set:
+    from tpu_pod_exporter.metrics import HistogramSpec
+
     names = set()
     for val in vars(schema).values():
         name = getattr(val, "name", None)
         if isinstance(name, str) and name.startswith("tpu_"):
             names.add(name)
+        if isinstance(val, HistogramSpec):
+            # Histograms expose _bucket/_count/_sum series (the parent
+            # family name above is the HELP/TYPE header only).
+            base = val.parent.name
+            names |= {f"{base}_bucket", f"{base}_count", f"{base}_sum"}
     return names
 
 

@@ -43,12 +43,41 @@ RECORD_TO_AGG = {
         "tpu_slice_tensorcore_duty_cycle_avg_percent",
     "slice:tpu_ici_link_bandwidth_bytes_per_second:sum":
         "tpu_slice_ici_bytes_per_second",
+    "slice:tpu_dcn_link_bandwidth_bytes_per_second:sum":
+        "tpu_slice_dcn_bytes_per_second",
+    "multislice:tpu_chip_info:count": "tpu_multislice_chip_count",
+    "multislice:tpu_hbm_used_bytes:sum": "tpu_multislice_hbm_used_bytes",
+    "multislice:tpu_dcn_link_bandwidth_bytes_per_second:sum":
+        "tpu_multislice_dcn_bytes_per_second",
+    "multislice:slices_reporting:count": "tpu_multislice_slices_reporting",
     "workload:tpu_pod_chip_count:sum": "tpu_workload_chip_count",
     "workload:tpu_pod_hbm_used_bytes:sum": "tpu_workload_hbm_used_bytes",
 }
 
 _AGG_RE = re.compile(r"^(sum|avg)\s+by\s+\(([^)]*)\)\s+\((\S+)\)$")
 _RATIO_RE = re.compile(r"^100\s*\*\s*(\S+)\s*/\s*(\S+)$")
+# The multi-slice info-series join:
+#   sum|count by (G) ( metric * on (J) group_left (K)
+#                      max by (M) (tpu_host_info{multislice_group!=""}) )
+_JOIN_RE = re.compile(
+    r"^(sum|count)\s+by\s+\(([^)]*)\)\s+\(\s*(\S+)\s*\*\s*on\s+\(([^)]*)\)"
+    r"\s+group_left\s+\(([^)]*)\)\s+max\s+by\s+\(([^)]*)\)"
+    r'\s+\((\w+)\{multislice_group!=""\}\)\s*\)$'
+)
+# Nested slice count over the join (slices REPORTING CHIPS, not merely
+# having a live exporter):
+#   count by (O) ( count by (I) ( metric * on (J) group_left (K)
+#                  max by (M) (tpu_host_info{multislice_group!=""}) ) )
+_NESTED_COUNT_JOIN_RE = re.compile(
+    r"^count\s+by\s+\(([^)]*)\)\s+\(\s*count\s+by\s+\(([^)]*)\)\s+"
+    r"\(\s*(\S+)\s*\*\s*on\s+\(([^)]*)\)\s+group_left\s+\(([^)]*)\)"
+    r"\s+max\s+by\s+\(([^)]*)\)"
+    r'\s+\((\w+)\{multislice_group!=""\}\)\s*\)\s*\)$'
+)
+
+
+def _split(raw: str) -> tuple[str, ...]:
+    return tuple(l.strip() for l in raw.split(","))
 
 
 def eval_rule(expr: str, samples, recorded):
@@ -73,6 +102,65 @@ def eval_rule(expr: str, samples, recorded):
             for k, v in groups.items()
         }
         return by, out
+    m = _JOIN_RE.match(expr)
+    if m:
+        op, by_raw, metric, on_raw, gl_raw, _max_by, info_name = m.groups()
+        by = _split(by_raw)
+        on = _split(on_raw)
+        gl = _split(gl_raw)
+        # Membership map from the info series (max-by dedup is implicit:
+        # the value is always 1 and hosts of one slice agree on the group).
+        member: dict[tuple, dict[str, str]] = {}
+        for s in samples:
+            if s.name == info_name and s.labels.get("multislice_group", ""):
+                member[tuple(s.labels.get(l, "") for l in on)] = {
+                    l: s.labels.get(l, "") for l in gl
+                }
+        groups: dict[tuple, list[float]] = {}
+        for s in samples:
+            if s.name != metric:
+                continue
+            extra = member.get(tuple(s.labels.get(l, "") for l in on))
+            if extra is None:
+                continue  # unmatched join drops the sample, like PromQL
+            joined = {**s.labels, **extra}
+            key = tuple(joined.get(l, "") for l in by)
+            groups.setdefault(key, []).append(s.value)
+        out = {
+            k: (float(len(v)) if op == "count" else sum(v))
+            for k, v in groups.items()
+        }
+        return by, out
+    m = _NESTED_COUNT_JOIN_RE.match(expr)
+    if m:
+        outer_raw, inner_raw, metric, on_raw, gl_raw, _max_by, info_name = (
+            m.groups()
+        )
+        outer = _split(outer_raw)
+        inner = _split(inner_raw)
+        on = _split(on_raw)
+        gl = _split(gl_raw)
+        member: dict[tuple, dict[str, str]] = {}
+        for s in samples:
+            if s.name == info_name and s.labels.get("multislice_group", ""):
+                member[tuple(s.labels.get(l, "") for l in on)] = {
+                    l: s.labels.get(l, "") for l in gl
+                }
+        inner_keys = set()
+        for s in samples:
+            if s.name != metric:
+                continue
+            extra = member.get(tuple(s.labels.get(l, "") for l in on))
+            if extra is None:
+                continue
+            joined = {**s.labels, **extra}
+            inner_keys.add(tuple(joined.get(l, "") for l in inner))
+        groups: dict[tuple, int] = {}
+        for ik in inner_keys:
+            labels = dict(zip(inner, ik))
+            key = tuple(labels.get(l, "") for l in outer)
+            groups[key] = groups.get(key, 0) + 1
+        return outer, {k: float(v) for k, v in groups.items()}
     m = _RATIO_RE.match(expr)
     if m:
         a_name, b_name = m.groups()
@@ -90,7 +178,9 @@ def eval_rule(expr: str, samples, recorded):
 
 def build_hosts():
     """Heterogeneous 2-slice fleet: per-host duty/HBM variation, multi-host
-    pods, an unattributed chip, and live ICI rates (needs two polls)."""
+    pods, an unattributed chip, live ICI/DCN rates (needs two polls), and
+    multi-slice membership (both slices share one group) so the multislice
+    join rules evaluate against real host_info series."""
     texts = []
     for slice_name, accel, workers in (
         ("slice-a", "v5p-32", 4),
@@ -105,6 +195,8 @@ def build_hosts():
                     duty_cycle_percent=20.0 * (w + 1),
                     ici_link_count=3,
                     ici_bytes_per_step=1_000_000.0 * (w + 1),
+                    dcn_link_count=1,
+                    dcn_bytes_per_step=250_000.0 * (w + 1),
                 ),
             )
             allocs = [
@@ -124,6 +216,7 @@ def build_hosts():
                 topology=HostTopology(
                     accelerator=accel, slice_name=slice_name,
                     host=f"{slice_name}-host-{w}", worker_id=str(w),
+                    multislice_group="ms-rules-group", num_slices="2",
                 ),
                 clock=lambda: fake_now[0],
             )
@@ -131,6 +224,25 @@ def build_hosts():
             fake_now[0] += 2.0
             c.poll_once()  # second poll: ICI bandwidth series exist
             texts.append(store.current().encode().decode())
+    # One host of a THIRD slice whose device backend is dead: it publishes
+    # tpu_host_info (live exporter, group member) but zero chip series.
+    # Both the aggregator and the recording rule must treat slice-dead as
+    # NOT reporting — counting it would hide exactly the whole-slice
+    # telemetry loss the slices-missing alert exists for (code-review r5).
+    dead_backend = FakeBackend(chips=4)
+    dead_backend.fail_next(10)
+    store = SnapshotStore()
+    Collector(
+        dead_backend, FakeAttribution(), store,
+        topology=HostTopology(
+            accelerator="v5p-32", slice_name="slice-dead",
+            host="slice-dead-host-0", worker_id="0",
+            multislice_group="ms-rules-group", num_slices="2",
+        ),
+    ).poll_once()
+    text = store.current().encode().decode()
+    assert "tpu_host_info{" in text and "tpu_chip_info{" not in text
+    texts.append(text)
     return texts
 
 

@@ -1,13 +1,16 @@
-"""Full-stack churn soak (BASELINE config 5's shape; VERDICT r3 #5).
+"""Full-stack churn soak (BASELINE config 5's shape; VERDICT r3 #5, r4 #3).
 
-Eight in-process exporters (fake 4-chip backends) scraped over real HTTP by
-one SliceAggregator, with continuous pod churn, injected backend/attribution
-faults, and a mid-soak host outage window — all at the production 1 s
-interval for ≥60 s of wall clock. Asserts the properties the per-poll tests
-can't: no stale series survive churn over many generations, hosts_reporting
-tracks an outage and recovers, CPU/RSS stay bounded, and no poll thread
-dies. Contrast the reference, whose loop dies on the first NVML/apiserver
-hiccup (main.go:119-137) and leaks stale series forever (SURVEY.md §2.6).
+Eight in-process exporters (fake 4-chip backends) forming a TWO-SLICE
+multi-slice group (4 hosts per slice, shared multislice_group, per-chip DCN
+links), scraped over real HTTP by one SliceAggregator, with continuous pod
+churn, injected backend/attribution faults, and a mid-soak host outage
+window — all at the production 1 s interval for ≥60 s of wall clock.
+Asserts the properties the per-poll tests can't: no stale series survive
+churn over many generations, per-slice hosts_reporting tracks an outage and
+recovers, cross-slice group rollups stay consistent with their per-slice
+parts, CPU/RSS stay bounded, and no poll thread dies. Contrast the
+reference, whose loop dies on the first NVML/apiserver hiccup
+(main.go:119-137) and leaks stale series forever (SURVEY.md §2.6).
 
 Scale knob: TPE_SOAK_SECONDS (default 60; the marker is ``slow``).
 """
@@ -30,11 +33,21 @@ from tpu_pod_exporter.metrics import SnapshotStore
 
 GIB = 1024**3
 NUM_HOSTS = 8
+HOSTS_PER_SLICE = 4
 CHIPS_PER_HOST = 4
 SOAK_S = float(os.environ.get("TPE_SOAK_SECONDS", "60"))
 INTERVAL_S = 1.0
-OUTAGE_HOST = 3
-SLICE_KEY = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+OUTAGE_HOST = 3  # in slice-a
+MULTISLICE_GROUP = "ms-soak-group"
+
+
+def _slice_of(worker_id: int) -> str:
+    return "slice-a" if worker_id < HOSTS_PER_SLICE else "slice-b"
+
+
+SLICE_A = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+SLICE_B = {"slice_name": "slice-b", "accelerator": "v5p-64"}
+GROUP_KEY = {"multislice_group": MULTISLICE_GROUP}
 
 
 def _read_rss_bytes() -> int:
@@ -87,6 +100,10 @@ def _make_host(worker_id: int):
             duty_cycle_percent=70.0,
             ici_link_count=6,
             ici_bytes_per_step=1_000_000.0,
+            # Cross-slice fabric: every chip carries one DCN link so the
+            # slice and group DCN rollups are exercised for the whole soak.
+            dcn_link_count=1,
+            dcn_bytes_per_step=250_000.0,
         ),
     )
     attr = FakeAttribution(
@@ -98,15 +115,18 @@ def _make_host(worker_id: int):
         host="127.0.0.1",
         interval_s=INTERVAL_S,
         accelerator="v5p-64",
-        slice_name="slice-a",
+        slice_name=_slice_of(worker_id),
         node_name=f"host-{worker_id}",
-        worker_id=str(worker_id),
+        worker_id=str(worker_id % HOSTS_PER_SLICE),
+        multislice_group=MULTISLICE_GROUP,
     )
     return ExporterApp(cfg, backend=backend, attribution=attr), backend, attr
 
 
 @pytest.mark.slow
 def test_full_stack_churn_soak():
+    # expected_slices comes from the GKE multi-slice environment.
+    os.environ["MEGASCALE_NUM_SLICES"] = "2"
     hosts = [_make_host(w) for w in range(NUM_HOSTS)]
     apps = [h[0] for h in hosts]
     for app in apps:
@@ -127,6 +147,7 @@ def test_full_stack_churn_soak():
     generation = 0
     outage_rounds_checked = 0
     recovered_rounds_checked = 0
+    dcn_rounds_checked = 0
     ru0 = resource.getrusage(resource.RUSAGE_SELF)
     t_start = time.monotonic()
     rss_warm = None
@@ -169,24 +190,52 @@ def test_full_stack_churn_soak():
 
             agg.poll_once()
             snap = agg_store.current()
-            reporting = snap.value("tpu_slice_hosts_reporting", SLICE_KEY)
+            rep_a = snap.value("tpu_slice_hosts_reporting", SLICE_A) or 0.0
+            rep_b = snap.value("tpu_slice_hosts_reporting", SLICE_B) or 0.0
             # An injected backend fault hides one MORE host for one round
             # (the collector deliberately serves no stale device data —
-            # collector.py phase 1), so the hard bound allows one extra
+            # collector.py phase 1), so the hard bounds allow one extra
             # missing host while the exact value must still be observed in
-            # several rounds of each regime.
+            # several rounds of each regime. The outage host is in slice-a.
             if in_outage:
-                assert NUM_HOSTS - 2 <= reporting <= NUM_HOSTS - 1, (
-                    f"t={elapsed:.0f}s outage: got {reporting}"
+                assert HOSTS_PER_SLICE - 2 <= rep_a <= HOSTS_PER_SLICE - 1, (
+                    f"t={elapsed:.0f}s outage: slice-a got {rep_a}"
                 )
-                if reporting == float(NUM_HOSTS - 1):
+                if rep_a == float(HOSTS_PER_SLICE - 1):
                     outage_rounds_checked += 1
             elif elapsed > 2.0 and frac >= 0.7:
-                assert reporting >= NUM_HOSTS - 1, (
-                    f"t={elapsed:.0f}s recovered: got {reporting}"
+                assert rep_a >= HOSTS_PER_SLICE - 1, (
+                    f"t={elapsed:.0f}s recovered: slice-a got {rep_a}"
                 )
-                if reporting == float(NUM_HOSTS):
+                if rep_a == float(HOSTS_PER_SLICE):
                     recovered_rounds_checked += 1
+            if elapsed > 2.0:
+                # slice-b never has the outage; one fault-hidden host max.
+                assert rep_b >= HOSTS_PER_SLICE - 1, (
+                    f"t={elapsed:.0f}s slice-b got {rep_b}"
+                )
+                # Cross-slice (multi-slice group) rollups must agree with
+                # their per-slice parts EVERY round, through churn, faults,
+                # and the outage window (VERDICT r4 #3).
+                assert snap.value(
+                    "tpu_multislice_slices_reporting", GROUP_KEY
+                ) == 2.0
+                assert snap.value(
+                    "tpu_multislice_expected_slices", GROUP_KEY
+                ) == 2.0
+                assert snap.value(
+                    "tpu_multislice_hosts_reporting", GROUP_KEY
+                ) == rep_a + rep_b
+                chips_a = snap.value("tpu_slice_chip_count", SLICE_A) or 0.0
+                chips_b = snap.value("tpu_slice_chip_count", SLICE_B) or 0.0
+                assert snap.value(
+                    "tpu_multislice_chip_count", GROUP_KEY
+                ) == chips_a + chips_b
+                dcn = snap.value(
+                    "tpu_multislice_dcn_bytes_per_second", GROUP_KEY
+                )
+                if dcn is not None and dcn > 0:
+                    dcn_rounds_checked += 1
 
             if rss_warm is None and elapsed >= 5.0:
                 rss_warm = _read_rss_bytes()
@@ -200,6 +249,7 @@ def test_full_stack_churn_soak():
         wall = time.monotonic() - t_start
         assert outage_rounds_checked >= 3
         assert recovered_rounds_checked >= 3
+        assert dcn_rounds_checked >= 3  # cross-slice DCN rollup was live
 
         # Let every exporter complete a poll on the final generation, then
         # take one settled aggregation round before end-state checks.
@@ -229,13 +279,13 @@ def test_full_stack_churn_soak():
                 assert text.count("tpu_chip_info{") == CHIPS_PER_HOST
                 assert 'source="device_partial"' in text
         # Aggregator rebuilt per round: its workload rollup carries only
-        # the live generation too.
+        # the live generation too (keyed per slice).
         agg_snap = agg_store.current()
-        assert agg_snap.value(
-            "tpu_workload_chip_count",
-            {"pod": final_pod, "namespace": "ml",
-             "slice_name": SLICE_KEY["slice_name"]},
-        ) == float(NUM_HOSTS * CHIPS_PER_HOST)
+        for sname in ("slice-a", "slice-b"):
+            assert agg_snap.value(
+                "tpu_workload_chip_count",
+                {"pod": final_pod, "namespace": "ml", "slice_name": sname},
+            ) == float(HOSTS_PER_SLICE * CHIPS_PER_HOST)
 
         # --- resource bounds ------------------------------------------
         ru1 = resource.getrusage(resource.RUSAGE_SELF)
@@ -253,6 +303,7 @@ def test_full_stack_churn_soak():
             f"({rss_warm / 1e6:.1f} → {rss_end / 1e6:.1f})"
         )
     finally:
+        os.environ.pop("MEGASCALE_NUM_SLICES", None)
         agg.close()
         for app in apps:
             app.stop()

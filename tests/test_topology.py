@@ -80,3 +80,45 @@ class TestDetectHostTopology:
             "host": "h",
             "worker_id": "0",
         }
+
+
+class TestMultislice:
+    def test_megascale_env_detection(self):
+        env = {
+            "TPU_ACCELERATOR_TYPE": "v5p-128",
+            "MEGASCALE_COORDINATOR_ADDRESS": "train-job-0.headless:8080",
+            "MEGASCALE_NUM_SLICES": "2",
+            "MEGASCALE_SLICE_ID": "1",
+        }
+        t = detect_host_topology(env=env)
+        assert t.multislice_group == "train-job-0.headless"  # port stripped
+        assert t.num_slices == "2"
+        assert t.slice_name == "1"  # MEGASCALE_SLICE_ID fallback
+        assert t.host_info_labels()["multislice_group"] == "train-job-0.headless"
+
+    def test_override_beats_env(self):
+        env = {"MEGASCALE_COORDINATOR_ADDRESS": "coord:8080"}
+        t = detect_host_topology(env=env, multislice_group="my-group")
+        assert t.multislice_group == "my-group"
+
+    def test_override_taken_verbatim_even_with_colons(self):
+        # An operator's group name may contain colons; only the ENV-derived
+        # endpoint gets port-stripped (code-review r5).
+        t = detect_host_topology(env={}, multislice_group="team:prod")
+        assert t.multislice_group == "team:prod"
+
+    def test_bare_ipv6_coordinator_not_mangled(self):
+        env = {"MEGASCALE_COORDINATOR_ADDRESS": "fd00::a"}
+        t = detect_host_topology(env=env)
+        assert t.multislice_group == "fd00::a"  # tail not numeric: kept
+
+    def test_bracketed_ipv6_with_port_stripped(self):
+        env = {"MEGASCALE_COORDINATOR_ADDRESS": "[fd00::a]:8080"}
+        t = detect_host_topology(env=env)
+        assert t.multislice_group == "[fd00::a]"
+
+    def test_not_multislice_is_empty(self):
+        t = detect_host_topology(env={})
+        assert t.multislice_group == ""
+        assert t.num_slices == ""
+        assert t.host_info_labels()["multislice_group"] == ""

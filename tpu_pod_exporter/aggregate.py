@@ -44,6 +44,8 @@ CONSUMED_NAMES = frozenset({
     "tpu_hbm_total_bytes",
     "tpu_tensorcore_duty_cycle_percent",
     "tpu_ici_link_bandwidth_bytes_per_second",
+    "tpu_dcn_link_bandwidth_bytes_per_second",
+    "tpu_host_info",
     "tpu_pod_chip_count",
     "tpu_pod_hbm_used_bytes",
 })
@@ -76,7 +78,7 @@ class _SliceAgg:
 
     __slots__ = ("hosts", "chip_series_hosts", "chips", "hbm_used",
                  "hbm_total", "used_chips", "total_chips", "duty_sum",
-                 "duty_n", "ici_bw", "ici_n")
+                 "duty_n", "ici_bw", "ici_n", "dcn_bw", "dcn_n")
 
     def __init__(self) -> None:
         self.hosts: set[str] = set()
@@ -99,8 +101,29 @@ class _SliceAgg:
         self.ici_bw = 0.0
         # Same rule as duty/HBM: a slice with NO ICI samples (runtime
         # without ICI counters) omits the rollup — 0.0 would read as
-        # "interconnect idle", not "unmeasured".
+        # "interconnect idle", not "unmeasured". Ditto DCN.
         self.ici_n = 0
+        self.dcn_bw = 0.0
+        self.dcn_n = 0
+
+
+class _GroupAgg:
+    """Mutable per-multislice-group accumulator for one round."""
+
+    __slots__ = ("slices", "hosts", "chips", "hbm_used", "hbm_used_n",
+                 "ici_bw", "ici_n", "dcn_bw", "dcn_n", "expected_slices")
+
+    def __init__(self) -> None:
+        self.slices: set[tuple[str, str]] = set()
+        self.hosts: set[str] = set()
+        self.chips = 0
+        self.hbm_used = 0.0
+        self.hbm_used_n = 0
+        self.ici_bw = 0.0
+        self.ici_n = 0
+        self.dcn_bw = 0.0
+        self.dcn_n = 0
+        self.expected_slices = 0
 
 
 class _WorkloadAgg:
@@ -172,6 +195,8 @@ class SliceAggregator:
 
         slices: dict[tuple[str, str], _SliceAgg] = {}
         workloads: dict[tuple[str, str, str], _WorkloadAgg] = {}
+        # (slice_name, accelerator) -> (multislice_group, num_slices str)
+        slice_groups: dict[tuple[str, str], tuple[str, str]] = {}
 
         for target, text, duration_s in results:
             ok = text is not None
@@ -187,7 +212,7 @@ class SliceAggregator:
                         f"parse:{target}", "bad exposition from %s: %s", target, e
                     )
                 else:
-                    self._consume(samples, slices, workloads)
+                    self._consume(samples, slices, workloads, slice_groups)
             if not ok:
                 self._counters.inc(
                     schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name, (target,)
@@ -242,6 +267,51 @@ class SliceAggregator:
                 )
             if agg.ici_n:
                 b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
+            if agg.dcn_n:
+                b.add(schema.TPU_SLICE_DCN_BYTES_PER_SECOND, agg.dcn_bw, key)
+
+        # Multi-slice group rollups: join slices to groups via the
+        # tpu_host_info membership map (BASELINE config 5). A slice without
+        # a group (single-slice deployment) contributes to no group series,
+        # and every sum keeps the absent-beats-fake-zero sample-count guards.
+        groups: dict[str, _GroupAgg] = {}
+        for skey, agg in slices.items():
+            membership = slice_groups.get(skey)
+            if membership is None:
+                continue
+            group, nslices_str = membership
+            g = groups.get(group)
+            if g is None:
+                g = groups[group] = _GroupAgg()
+            g.slices.add(skey)
+            g.hosts |= agg.hosts
+            g.chips += agg.chips
+            g.hbm_used += agg.hbm_used
+            g.hbm_used_n += len(agg.used_chips)
+            g.ici_bw += agg.ici_bw
+            g.ici_n += agg.ici_n
+            g.dcn_bw += agg.dcn_bw
+            g.dcn_n += agg.dcn_n
+            try:
+                g.expected_slices = max(g.expected_slices, int(nslices_str))
+            except ValueError:
+                pass
+        for group, g in groups.items():
+            gkey = (group,)
+            b.add(schema.TPU_MULTISLICE_SLICES_REPORTING, float(len(g.slices)), gkey)
+            if g.expected_slices > 0:
+                b.add(
+                    schema.TPU_MULTISLICE_EXPECTED_SLICES,
+                    float(g.expected_slices), gkey,
+                )
+            b.add(schema.TPU_MULTISLICE_HOSTS_REPORTING, float(len(g.hosts)), gkey)
+            b.add(schema.TPU_MULTISLICE_CHIP_COUNT, float(g.chips), gkey)
+            if g.hbm_used_n:
+                b.add(schema.TPU_MULTISLICE_HBM_USED_BYTES, g.hbm_used, gkey)
+            if g.ici_n:
+                b.add(schema.TPU_MULTISLICE_ICI_BYTES_PER_SECOND, g.ici_bw, gkey)
+            if g.dcn_n:
+                b.add(schema.TPU_MULTISLICE_DCN_BYTES_PER_SECOND, g.dcn_bw, gkey)
 
         for key, w in workloads.items():
             b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
@@ -260,7 +330,7 @@ class SliceAggregator:
         self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
 
     @staticmethod
-    def _consume(samples, slices, workloads) -> None:
+    def _consume(samples, slices, workloads, slice_groups) -> None:
         """Fold one host's parsed samples into the round accumulators."""
         for s in samples:
             name = s.name
@@ -310,6 +380,24 @@ class SliceAggregator:
                 host = s.labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
+            elif name == "tpu_dcn_link_bandwidth_bytes_per_second":
+                agg = SliceAggregator._slice(slices, s.labels)
+                agg.dcn_bw += s.value
+                agg.dcn_n += 1
+                host = s.labels.get("host")
+                if host:
+                    agg.chip_series_hosts.add(host)
+            elif name == "tpu_host_info":
+                # Multi-slice membership join key: slice -> (group,
+                # expected slice count). Hosts of one slice agree on both
+                # (same MEGASCALE env); last writer wins harmlessly.
+                group = s.labels.get("multislice_group", "")
+                if group:
+                    key = (
+                        s.labels.get("slice_name", ""),
+                        s.labels.get("accelerator", ""),
+                    )
+                    slice_groups[key] = (group, s.labels.get("num_slices", ""))
             elif name in ("tpu_pod_chip_count", "tpu_pod_hbm_used_bytes"):
                 pod = s.labels.get("pod", "")
                 if not pod:

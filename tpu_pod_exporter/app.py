@@ -184,6 +184,7 @@ class ExporterApp:
             slice_name=cfg.slice_name,
             host=cfg.node_name,
             worker_id=cfg.worker_id,
+            multislice_group=cfg.multislice_group,
         )
         scanner = None
         if cfg.process_metrics:

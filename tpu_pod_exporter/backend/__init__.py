@@ -85,6 +85,10 @@ class ChipSample(NamedTuple):
     # Allocator high-water mark since runtime start (jaxdev:
     # memory_stats peak_bytes_in_use); None when the backend can't report it.
     hbm_peak_bytes: float | None = None
+    # DCN (data-center network, the cross-slice fabric in multi-slice
+    # deployments) cumulative traffic counters — same shape as ici_links,
+    # empty on runtimes/surfaces that don't serve them.
+    dcn_links: tuple[IciLinkSample, ...] = ()
 
 
 class HostSample(NamedTuple):

@@ -43,6 +43,9 @@ class FakeChipScript:
     ici_link_count: int = 6  # 3D torus: ±x, ±y, ±z  [design]
     # cumulative bytes per link per poll step
     ici_bytes_per_step: float | Callable[[int], float] = 0.0
+    # DCN (cross-slice fabric) links — 0 outside multi-slice shapes.
+    dcn_link_count: int = 0
+    dcn_bytes_per_step: float | Callable[[int], float] = 0.0
 
     _LINK_IDS = tuple(str(i) for i in range(16))
 
@@ -78,6 +81,14 @@ class FakeChipScript:
             )
             if link_cache is not None:
                 link_cache[(per_step, self.ici_link_count)] = links
+        dcn_links: tuple = ()
+        if self.dcn_link_count:
+            dcn_total = self._resolve(self.dcn_bytes_per_step, step) * (step + 1)
+            mk = tuple.__new__
+            dcn_links = tuple(
+                mk(IciLinkSample, (f"dcn{li}", dcn_total))
+                for li in range(self.dcn_link_count)
+            )
         peak = None
         if self.hbm_peak_bytes is not None:
             peak = self._resolve(self.hbm_peak_bytes, step)
@@ -88,6 +99,7 @@ class FakeChipScript:
             tensorcore_duty_cycle_percent=duty,
             ici_links=links,
             hbm_peak_bytes=peak,
+            dcn_links=dcn_links,
         )
 
 

@@ -55,6 +55,16 @@ ICI_CANDIDATES = (
     "tpu.runtime.interconnect.transferred.bytes",
     "megascale.ici.transferred.bytes",
 )
+# DCN (data-center network — the cross-slice fabric of a GKE multi-slice
+# deployment, BASELINE config 5) rides the same discovery ladder as ICI:
+# the exact public name is unconfirmed until probed on real multi-slice
+# hardware, so candidates are tried via enumeration, then direct probes.
+DCN_TRANSFERRED = "tpu.runtime.dcn.transferred.bytes"
+DCN_CANDIDATES = (
+    DCN_TRANSFERRED,
+    "tpu.runtime.dcn.traffic.bytes",
+    "megascale.dcn.transferred.bytes",
+)
 
 GET_METRIC_METHOD = "/tpu.monitoring.runtime.RuntimeMetricService/GetRuntimeMetric"
 LIST_METRICS_METHOD = (
@@ -166,6 +176,25 @@ def ici_rows(resp) -> dict[str, dict[str, float]]:
     return out
 
 
+class _CounterDiscovery:
+    """Discovery-ladder state for one optional per-link counter family.
+
+    ``metric``: None = unprobed; False = affirmatively unsupported; str =
+    the confirmed metric name to query every poll. ``vanished``: names that
+    were confirmed and then NOT_FOUND on query (stale enumeration table /
+    runtime swap) — excluded from rediscovery so an inconsistent runtime
+    can't flap discover→fail every poll.
+    """
+
+    __slots__ = ("kind", "candidates", "metric", "vanished")
+
+    def __init__(self, kind: str, candidates: tuple[str, ...]) -> None:
+        self.kind = kind
+        self.candidates = candidates
+        self.metric: str | None | bool = None
+        self.vanished: set[str] = set()
+
+
 class LibtpuMetricsBackend(DeviceBackend):
     name = "libtpu"
 
@@ -187,13 +216,10 @@ class LibtpuMetricsBackend(DeviceBackend):
         self._channel = None
         self._get = None
         self._list = None
-        # None = unprobed; False = affirmatively unsupported; str = the
-        # confirmed metric name to query every poll.
-        self._ici_metric: str | None | bool = None
-        # A name that was confirmed and then NOT_FOUND on query (stale
-        # enumeration table / runtime swap). Excluded from rediscovery so
-        # an inconsistent runtime can't flap discover→fail every poll.
-        self._ici_vanished: set[str] = set()
+        # One discovery-ladder state per optional per-link counter family
+        # (ICI and DCN share the machinery; each confirms independently).
+        self._ici_disc = _CounterDiscovery("ICI", ICI_CANDIDATES)
+        self._dcn_disc = _CounterDiscovery("DCN", DCN_CANDIDATES)
         if device_paths is None:
             import re
 
@@ -266,23 +292,26 @@ class LibtpuMetricsBackend(DeviceBackend):
             raise
         return [m.metric_name for m in resp.supported_metric]
 
-    def _resolve_ici_metric(self) -> dict[str, dict[str, float]] | None:
-        """One-time discovery of the ICI counter's real name. Sets
-        ``self._ici_metric`` to the confirmed name, or False when the
-        runtime affirmatively serves none of the candidates. Returns the
-        metric rows when discovery already fetched them (the probe path),
-        so the first poll doesn't issue the same RPC twice. Raises on
-        transient errors (leaves the probe un-latched for the next poll).
-        Names in ``self._ici_vanished`` are excluded — see __init__."""
-        candidates = [n for n in ICI_CANDIDATES if n not in self._ici_vanished]
-        supported = self.list_supported_metrics()
+    def _resolve_counter(self, disc: "_CounterDiscovery", get_supported):
+        """One-time discovery of one counter family's real name. Sets
+        ``disc.metric`` to the confirmed name, or False when the runtime
+        affirmatively serves none of the candidates. Returns the metric
+        rows when discovery already fetched them (the probe path), so the
+        first poll doesn't issue the same RPC twice. Raises on transient
+        errors (leaves the probe un-latched for the next poll). Names in
+        ``disc.vanished`` are excluded — see _CounterDiscovery.
+        ``get_supported`` memoizes the enumeration RPC so ICI and DCN
+        resolving in the same poll share one ListSupportedMetrics call."""
+        candidates = [n for n in disc.candidates if n not in disc.vanished]
+        supported = get_supported()
         if supported is not None and HBM_USAGE not in supported:
             # Sanity check before trusting enumeration: sample() queried
             # HBM_USAGE successfully moments ago, so a list omitting it
             # means the RPC exists but its wire shape differs from our
             # guessed proto (proto3 parses a mismatched response as empty,
-            # not as an error). Trusting it would silently latch ICI off on
-            # a runtime that serves it — fall through to direct probes.
+            # not as an error). Trusting it would silently latch the
+            # counter off on a runtime that serves it — fall through to
+            # direct probes.
             log.warning(
                 "ListSupportedMetrics omitted %s (just served); treating "
                 "enumeration as unreliable and probing candidates directly",
@@ -292,25 +321,29 @@ class LibtpuMetricsBackend(DeviceBackend):
         if supported is not None:
             for name in candidates:
                 if name in supported:
-                    self._ici_metric = name
-                    log.info("ICI counter confirmed via enumeration: %s", name)
+                    disc.metric = name
+                    log.info(
+                        "%s counter confirmed via enumeration: %s",
+                        disc.kind, name,
+                    )
                     return None
-            # Nothing named like our candidates; surface what looked ICI-ish
-            # so an operator can extend ICI_CANDIDATES from the logs.
-            icish = [n for n in supported if "ici" in n.lower()]
+            # Nothing named like our candidates; surface what looked close
+            # so an operator can extend the candidate list from the logs.
+            needle = disc.kind.lower()
+            kindish = [n for n in supported if needle in n.lower()]
             log.info(
-                "no known ICI counter in %d supported metrics%s",
-                len(supported),
-                f"; ici-like names: {icish}" if icish else "",
+                "no known %s counter in %d supported metrics%s",
+                disc.kind, len(supported),
+                f"; {needle}-like names: {kindish}" if kindish else "",
             )
-            self._ici_metric = False
+            disc.metric = False
             return None
         # No enumeration RPC: probe candidates directly.
         for name in candidates:
             try:
                 rows = self._query_ici(name)
-                self._ici_metric = name
-                log.info("ICI counter confirmed by probe: %s", name)
+                disc.metric = name
+                log.info("%s counter confirmed by probe: %s", disc.kind, name)
                 return rows
             except self._grpc.RpcError as e:
                 if e.code() in (
@@ -320,9 +353,54 @@ class LibtpuMetricsBackend(DeviceBackend):
                 ):
                     continue  # affirmatively not this name; try the next
                 raise  # transient — retry the whole probe next poll
-        log.info("ICI counters unsupported by this runtime (all candidates)")
-        self._ici_metric = False
+        log.info(
+            "%s counters unsupported by this runtime (all candidates)",
+            disc.kind,
+        )
+        disc.metric = False
         return None
+
+    def _sample_counter(
+        self, disc: "_CounterDiscovery", partial: list[str], get_supported
+    ) -> dict[str, dict[str, float]]:
+        """One poll's rows for one optional counter family: resolve on
+        first contact, then query the confirmed name, handling vanish
+        (re-probe without the liar) and transient errors (surface, keep)."""
+        rows: dict[str, dict[str, float]] = {}
+        discovered_rows = None
+        if disc.metric is None:
+            try:
+                discovered_rows = self._resolve_counter(disc, get_supported)
+            except Exception as e:  # noqa: BLE001 — transient: retry next poll
+                partial.append(f"{disc.kind} discovery failed: {e}")
+        if isinstance(disc.metric, str):
+            if discovered_rows is not None:
+                rows = discovered_rows  # probe already fetched this poll's rows
+            else:
+                try:
+                    rows = self._query_ici(disc.metric)
+                except Exception as e:  # noqa: BLE001
+                    code = getattr(e, "code", lambda: None)()
+                    if code in (
+                        self._grpc.StatusCode.NOT_FOUND,
+                        self._grpc.StatusCode.UNIMPLEMENTED,
+                        self._grpc.StatusCode.INVALID_ARGUMENT,
+                    ):
+                        # The runtime stopped serving the confirmed name
+                        # (runtime swap, or a stale enumeration table):
+                        # rediscover next poll, excluding this name so an
+                        # inconsistent runtime can't flap forever.
+                        log.info(
+                            "confirmed %s metric vanished; re-probing "
+                            "without it: %s", disc.kind, e,
+                        )
+                        disc.vanished.add(disc.metric)
+                        disc.metric = None
+                    else:
+                        # Transient (timeout/unavailable) — keep the
+                        # confirmed name, surface the failure.
+                        partial.append(f"{disc.kind} query failed: {e}")
+        return rows
 
     def sample(self) -> HostSample:
         partial: list[str] = []
@@ -342,40 +420,15 @@ class LibtpuMetricsBackend(DeviceBackend):
             duty = {}
             partial.append(f"duty-cycle query failed: {e}")
 
-        ici: dict[str, dict[str, float]] = {}
-        discovered_rows: dict[str, dict[str, float]] | None = None
-        if self._ici_metric is None:
-            try:
-                discovered_rows = self._resolve_ici_metric()
-            except Exception as e:  # noqa: BLE001 — transient: retry next poll
-                partial.append(f"ICI discovery failed: {e}")
-        if isinstance(self._ici_metric, str):
-            if discovered_rows is not None:
-                ici = discovered_rows  # probe already fetched this poll's rows
-            else:
-                try:
-                    ici = self._query_ici(self._ici_metric)
-                except Exception as e:  # noqa: BLE001
-                    code = getattr(e, "code", lambda: None)()
-                    if code in (
-                        self._grpc.StatusCode.NOT_FOUND,
-                        self._grpc.StatusCode.UNIMPLEMENTED,
-                        self._grpc.StatusCode.INVALID_ARGUMENT,
-                    ):
-                        # The runtime stopped serving the confirmed name
-                        # (runtime swap, or a stale enumeration table):
-                        # rediscover next poll, excluding this name so an
-                        # inconsistent runtime can't flap forever.
-                        log.info(
-                            "confirmed ICI metric vanished; re-probing "
-                            "without it: %s", e
-                        )
-                        self._ici_vanished.add(self._ici_metric)
-                        self._ici_metric = None
-                    else:
-                        # Transient (timeout/unavailable) — keep the
-                        # confirmed name, surface the failure.
-                        partial.append(f"ICI query failed: {e}")
+        enum_memo: list = []  # one ListSupportedMetrics shared per poll
+
+        def get_supported():
+            if not enum_memo:
+                enum_memo.append(self.list_supported_metrics())
+            return enum_memo[0]
+
+        ici = self._sample_counter(self._ici_disc, partial, get_supported)
+        dcn = self._sample_counter(self._dcn_disc, partial, get_supported)
 
         chips: list[ChipSample] = []
         # Enumerate the UNION of every response's device axis, not just the
@@ -389,7 +442,7 @@ class LibtpuMetricsBackend(DeviceBackend):
         # so when the HBM devices are all-numeric, non-numeric duty/ICI
         # extras are dropped with a partial error instead of enumerated.
         devices = set(usage) | set(total)
-        aux = (set(duty) | set(ici)) - devices
+        aux = (set(duty) | set(ici) | set(dcn)) - devices
         if "" in devices or "" in aux:
             # An attribute-less row has no device identity to publish under;
             # dropping it silently would be the same unaccounted undercount
@@ -441,6 +494,12 @@ class LibtpuMetricsBackend(DeviceBackend):
                     IciLinkSample(link=lk, transferred_bytes_total=v)
                     for lk, v in sorted(ici[dev_id].items(), key=_link_sort_key)
                 )
+            dcn_links = ()
+            if dev_id in dcn:
+                dcn_links = tuple(
+                    IciLinkSample(link=lk, transferred_bytes_total=v)
+                    for lk, v in sorted(dcn[dev_id].items(), key=_link_sort_key)
+                )
             chips.append(
                 ChipSample(
                     info=ChipInfo(
@@ -452,6 +511,7 @@ class LibtpuMetricsBackend(DeviceBackend):
                     hbm_total_bytes=total.get(dev_id),
                     tensorcore_duty_cycle_percent=duty.get(dev_id),
                     ici_links=links,
+                    dcn_links=dcn_links,
                 )
             )
         return HostSample(chips=tuple(chips), partial_errors=tuple(partial))

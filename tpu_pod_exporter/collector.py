@@ -116,6 +116,13 @@ class Collector:
         self._topo_tuple = (
             t["accelerator"], t["slice_name"], t["host"], t["worker_id"],
         )
+        # tpu_host_info label tuple (TOPO_LABELS + multislice membership):
+        # static for the process lifetime, published every poll as the
+        # cross-slice join key (see HostTopology.host_info_labels).
+        hi = self._topology.host_info_labels()
+        self._host_info_tuple = self._topo_tuple + (
+            hi["multislice_group"], hi["num_slices"],
+        )
         self._last_attr: AttributionSnapshot | None = None
         self._last_attr_at: float = 0.0
         # Last good holder set, reused under the same bounded-staleness rule
@@ -142,6 +149,10 @@ class Collector:
         # device reset in the same instant, so exported counters stay
         # monotonic.
         self._chip_state: dict[int, dict[str, list]] = {}
+        # Same per-link fold state for DCN counters. No flat/numpy fast
+        # path: DCN cardinality is a handful of NIC-class links per host
+        # (vs 6 ICI links × every chip), so a plain loop is already cheap.
+        self._dcn_state: dict[int, dict[str, list]] = {}
         # Monotonic publish sequence for polls that carried a device sample;
         # a link's rate is published only when it was also seen at seq-1
         # (dt measures exactly that window).
@@ -318,6 +329,8 @@ class Collector:
             duty_s = b.series(schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT)
             ici_total_s = b.series(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL)
             ici_bw_s = b.series(schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND)
+            dcn_total_s = b.series(schema.TPU_DCN_TRANSFERRED_BYTES_TOTAL)
+            dcn_bw_s = b.series(schema.TPU_DCN_LINK_BANDWIDTH_BYTES_PER_SECOND)
             label_cache = self._label_cache
             if len(label_cache) > 4 * len(host_sample.chips) + 64:
                 label_cache.clear()
@@ -330,6 +343,8 @@ class Collector:
                 live = {c.info.chip_id for c in host_sample.chips}
                 for cid in [cid for cid in chip_state if cid not in live]:
                     del chip_state[cid]
+                for cid in [c for c in self._dcn_state if c not in live]:
+                    del self._dcn_state[cid]
             chips = host_sample.chips
             flat = self._ici_flat
             # Steady-state fast path precondition; per-chip identity is
@@ -466,6 +481,7 @@ class Collector:
                 self._fold_ici_fast(ici_total_s, ici_bw_s, dt, seq)
             else:
                 self._fold_ici_slow(chip_cached, ici_total_s, ici_bw_s, dt, seq)
+            self._fold_dcn(chip_cached, dcn_total_s, dcn_bw_s, dt, seq)
             self._prev_ici_at = now_mono
 
         for rk, (nchips, hbm, readable) in pod_rollup.items():
@@ -480,6 +496,10 @@ class Collector:
                 schema.hbm_used_percent(hbm, hbm_total),
                 (pid, pod),
             )
+
+        # Host identity incl. multi-slice membership — the cross-slice
+        # rollup join key (always published; empty labels off multi-slice).
+        b.add(schema.TPU_HOST_INFO, 1.0, self._host_info_tuple)
 
         # Kubelet inventory (absent when the source cannot report it; an
         # allocated count of 0 on an idle node is real data, not absence).
@@ -674,6 +694,44 @@ class Collector:
             "folded": np.array([r[1] for r in flat_recs], dtype=np.float64),
             "seq": seq,
         }
+
+    def _fold_dcn(self, chip_cached, dcn_total_s, dcn_bw_s, dt, seq) -> None:
+        """Per-link DCN fold: identical semantics to the slow ICI fold
+        (monotonic with reset tolerance; rate only for links also seen at
+        seq-1). Shares each chip's cached link-label-tuple dict with ICI —
+        a given link id renders to the same label tuple either way, and
+        the two counter families never collide (different metric names)."""
+        dcn_state = self._dcn_state
+        for chip, cached in chip_cached:
+            links = chip.dcn_links
+            if not links:
+                continue
+            chip_tuple, link_tuples, _ = cached
+            link_recs = dcn_state.get(chip.info.chip_id)
+            if link_recs is None:
+                link_recs = dcn_state[chip.info.chip_id] = {}
+            for link in links:
+                raw = link.transferred_bytes_total
+                lv = link_tuples.get(link.link)
+                if lv is None:
+                    lv = link_tuples[link.link] = chip_tuple + (link.link,)
+                rec = link_recs.get(link.link)
+                if rec is None:
+                    folded = raw if raw >= 0 else 0.0
+                    link_recs[link.link] = [raw, folded, folded, seq]
+                    dcn_total_s[lv] = folded
+                    continue
+                raw_prev, folded, rate_base, last_seq = rec
+                delta = raw - raw_prev
+                if delta > 0:
+                    folded = rec[1] = folded + delta
+                rec[0] = raw
+                dcn_total_s[lv] = folded
+                if dt is not None and last_seq == seq - 1:
+                    bw = (folded - rate_base) / dt
+                    dcn_bw_s[lv] = round(bw) if bw > 0.0 else 0.0
+                rec[2] = folded
+                rec[3] = seq
 
     _PAGE_SIZE = None
 

@@ -56,6 +56,10 @@ class ExporterConfig:
     slice_name: str = ""
     node_name: str = ""
     worker_id: str = ""
+    # Multi-slice group identity override (else MEGASCALE_COORDINATOR_ADDRESS
+    # from the GKE multi-slice environment); rides tpu_host_info, never
+    # per-chip series.
+    multislice_group: str = ""
     log_level: str = "info"
 
     @staticmethod

@@ -114,6 +114,24 @@ TPU_ICI_TRANSFERRED_BYTES_TOTAL = MetricSpec(
     label_names=ICI_LABELS,
 )
 
+# --- DCN (data-center network — cross-slice fabric, multi-slice) -------------
+# Same per-link shape as ICI. Absent entirely (no series) on runtimes that
+# serve no DCN counters — single-slice deployments never see these.
+
+TPU_DCN_LINK_BANDWIDTH_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_dcn_link_bandwidth_bytes_per_second",
+    help="Observed DCN (cross-slice network) traffic rate on one link since the previous poll.",
+    type=GAUGE,
+    label_names=ICI_LABELS,
+)
+
+TPU_DCN_TRANSFERRED_BYTES_TOTAL = MetricSpec(
+    name="tpu_dcn_transferred_bytes_total",
+    help="Cumulative bytes transferred over one DCN (cross-slice network) link.",
+    type=COUNTER,
+    label_names=ICI_LABELS,
+)
+
 # --- Per-process holders (procfs scanner; --process-metrics) -----------------
 
 # pid/comm/pod_uid come from /proc: the process that holds the chip's device
@@ -169,6 +187,15 @@ TPU_KUBELET_ALLOCATED_CHIPS = MetricSpec(
     help="TPU devices currently allocated to pods on this node, per the kubelet.",
     type=GAUGE,
     label_names=TOPO_LABELS,
+)
+
+# --- Host identity (multi-slice membership join key) -------------------------
+
+TPU_HOST_INFO = MetricSpec(
+    name="tpu_host_info",
+    help="Static host identity incl. multi-slice membership; value is always 1. multislice_group is the cross-slice rollup join key (empty outside multi-slice deployments).",
+    type=GAUGE,
+    label_names=TOPO_LABELS + ("multislice_group", "num_slices"),
 )
 
 # --- Exporter self-metrics (SURVEY.md §5: tracing/observability) -------------
@@ -297,6 +324,9 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_TENSORCORE_DUTY_CYCLE_PERCENT,
     TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND,
     TPU_ICI_TRANSFERRED_BYTES_TOTAL,
+    TPU_DCN_LINK_BANDWIDTH_BYTES_PER_SECOND,
+    TPU_DCN_TRANSFERRED_BYTES_TOTAL,
+    TPU_HOST_INFO,
     TPU_POD_CHIP_COUNT,
     TPU_POD_HBM_USED_BYTES,
     TPU_KUBELET_ALLOCATABLE_CHIPS,
@@ -371,6 +401,67 @@ TPU_SLICE_ICI_BYTES_PER_SECOND = MetricSpec(
     label_names=SLICE_LABELS,
 )
 
+TPU_SLICE_DCN_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_slice_dcn_bytes_per_second",
+    help="Sum of per-link DCN (cross-slice network) traffic rates across the slice.",
+    type=GAUGE,
+    label_names=SLICE_LABELS,
+)
+
+# Cross-SLICE (multi-slice group) rollups. Joined via tpu_host_info's
+# multislice_group label (BASELINE config 5: 2x v5p-128 over DCN); a slice
+# with an empty group contributes to no group series.
+MULTISLICE_LABELS: tuple[str, ...] = ("multislice_group",)
+
+TPU_MULTISLICE_SLICES_REPORTING = MetricSpec(
+    name="tpu_multislice_slices_reporting",
+    help="Slices of this multi-slice group contributing chip samples this round.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_EXPECTED_SLICES = MetricSpec(
+    name="tpu_multislice_expected_slices",
+    help="Slices this group SHOULD have (MEGASCALE_NUM_SLICES); alert when reporting < expected.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_HOSTS_REPORTING = MetricSpec(
+    name="tpu_multislice_hosts_reporting",
+    help="Hosts across all slices of this group contributing chip samples this round.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_CHIP_COUNT = MetricSpec(
+    name="tpu_multislice_chip_count",
+    help="TPU chips reporting across all slices of this multi-slice group.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_HBM_USED_BYTES = MetricSpec(
+    name="tpu_multislice_hbm_used_bytes",
+    help="Sum of HBM bytes in use across all chips of this multi-slice group.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_ICI_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_multislice_ici_bytes_per_second",
+    help="Sum of intra-slice ICI traffic rates across the group.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
+TPU_MULTISLICE_DCN_BYTES_PER_SECOND = MetricSpec(
+    name="tpu_multislice_dcn_bytes_per_second",
+    help="Sum of cross-slice DCN traffic rates across the group.",
+    type=GAUGE,
+    label_names=MULTISLICE_LABELS,
+)
+
 # Cross-host workload rollups: a multi-host JobSet replica appears as the
 # same {pod, namespace} on several hosts; these sum over that.
 WORKLOAD_LABELS: tuple[str, ...] = ("pod", "namespace", "slice_name")
@@ -438,6 +529,14 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_SLICE_HBM_USED_PERCENT,
     TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
     TPU_SLICE_ICI_BYTES_PER_SECOND,
+    TPU_SLICE_DCN_BYTES_PER_SECOND,
+    TPU_MULTISLICE_SLICES_REPORTING,
+    TPU_MULTISLICE_EXPECTED_SLICES,
+    TPU_MULTISLICE_HOSTS_REPORTING,
+    TPU_MULTISLICE_CHIP_COUNT,
+    TPU_MULTISLICE_HBM_USED_BYTES,
+    TPU_MULTISLICE_ICI_BYTES_PER_SECOND,
+    TPU_MULTISLICE_DCN_BYTES_PER_SECOND,
     TPU_WORKLOAD_CHIP_COUNT,
     TPU_WORKLOAD_HBM_USED_BYTES,
     TPU_WORKLOAD_HOSTS,

@@ -29,6 +29,7 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
     import grpc
 
     from tpu_pod_exporter.backend.libtpu import (
+        DCN_CANDIDATES,
         DUTY_CYCLE,
         HBM_TOTAL,
         HBM_USAGE,
@@ -86,7 +87,10 @@ def probe(addr: str, timeout_s: float = 3.0, max_rows: int = 8) -> dict:
         names = report["supported"]
         if names is None:
             # No enumeration RPC: probe the names the backend knows about.
-            names = [HBM_USAGE, HBM_TOTAL, DUTY_CYCLE, *ICI_CANDIDATES]
+            names = [
+                HBM_USAGE, HBM_TOTAL, DUTY_CYCLE,
+                *ICI_CANDIDATES, *DCN_CANDIDATES,
+            ]
         for name in names:
             try:
                 resp = backend.query_raw(name, timeout_s=timeout_s)

@@ -151,6 +151,9 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
                 "ici": {
                     l.link: l.transferred_bytes_total for l in chip.ici_links
                 },
+                "dcn": {
+                    l.link: l.transferred_bytes_total for l in chip.dcn_links
+                },
                 "pod": owner.pod if owner else None,
                 "namespace": owner.namespace if owner else None,
                 "container": owner.container if owner else None,

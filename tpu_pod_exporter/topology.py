@@ -85,12 +85,23 @@ def parse_accelerator_type(accel: str) -> SliceTopology:
 
 @dataclass(frozen=True)
 class HostTopology:
-    """The label values this exporter instance attaches to every series."""
+    """The label values this exporter instance attaches to every series.
+
+    ``multislice_group``/``num_slices`` are NOT per-series labels (that
+    would bloat every chip series for a dimension most deployments lack);
+    they ride the once-per-host ``tpu_host_info`` series, which aggregators
+    and recording rules join on (the Prometheus info-series pattern).
+    """
 
     accelerator: str = ""
     slice_name: str = ""
     host: str = ""
     worker_id: str = ""
+    # Multi-slice membership (BASELINE config 5, GKE multi-slice): the
+    # group identity shared by all slices of one multi-slice workload, and
+    # the expected slice count. Empty / "0" outside multi-slice.
+    multislice_group: str = ""
+    num_slices: str = ""
     slice_topology: SliceTopology = field(default_factory=SliceTopology)
 
     def labels(self) -> dict[str, str]:
@@ -101,6 +112,13 @@ class HostTopology:
             "worker_id": self.worker_id,
         }
 
+    def host_info_labels(self) -> dict[str, str]:
+        return {
+            **self.labels(),
+            "multislice_group": self.multislice_group,
+            "num_slices": self.num_slices,
+        }
+
 
 def detect_host_topology(
     env: dict[str, str] | None = None,
@@ -108,6 +126,7 @@ def detect_host_topology(
     slice_name: str = "",
     host: str = "",
     worker_id: str = "",
+    multislice_group: str = "",
 ) -> HostTopology:
     """Build HostTopology from overrides > environment > hostname."""
     e = os.environ if env is None else env
@@ -121,10 +140,26 @@ def detect_host_topology(
         # GKE multi-slice: jobset/replicated-job identity downward-API convention
         or e.get("MEGASCALE_SLICE_ID", "")
     )
+    # Multi-slice group identity: explicit override first (taken VERBATIM —
+    # an operator's group name may legitimately contain colons), else the
+    # MEGASCALE coordinator address every slice of one group shares (GKE
+    # multi-slice injects it into all workers). A trailing :port is
+    # stripped from the env value only when the tail is numeric, so a bare
+    # IPv6 address is not mangled.
+    group = multislice_group
+    if not group:
+        group = e.get("MEGASCALE_COORDINATOR_ADDRESS", "")
+        if ":" in group:
+            head, _, tail = group.rpartition(":")
+            if tail.isdigit():
+                group = head
+    nslices = e.get("MEGASCALE_NUM_SLICES", "") if group else ""
     return HostTopology(
         accelerator=accel,
         slice_name=sname,
         host=hostname,
         worker_id=wid,
+        multislice_group=group,
+        num_slices=nslices,
         slice_topology=parse_accelerator_type(accel),
     )
