@@ -779,3 +779,128 @@ class TestAggregatorCli:
             if proc.poll() is None:
                 proc.kill()
             app.stop()
+
+
+class TestLayoutParser:
+    """parse_exposition_layout: value-only re-parse between churn events
+    (VERDICT r4 #6 — the parse-side twin of the exporter's PrefixCache)."""
+
+    NAMES = frozenset({"m", "tpu_x"})
+
+    def _both(self, texts):
+        """Parse a sequence of bodies through one LayoutCache; assert each
+        round equals the reference parser's output."""
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache()
+        for text in texts:
+            got = parse_exposition_layout(text, self.NAMES, layout)
+            want = [
+                (s.name, s.labels, s.value)
+                for s in parse_exposition(text, names=self.NAMES)
+            ]
+            assert got == want, text
+        return layout
+
+    def test_steady_state_values_change(self):
+        t1 = 'm{a="1"} 5\nother{a="1"} 1\nm{a="2"} 6\ntpu_x 7\n'
+        t2 = 'm{a="1"} 50\nother{a="1"} 2\nm{a="2"} 60\ntpu_x 70\n'
+        layout = self._both([t1, t2, t2, t1])
+        # Labels dicts are REUSED across rounds (that's the point).
+        from tpu_pod_exporter.metrics.parse import parse_exposition_layout
+
+        r1 = parse_exposition_layout(t1, self.NAMES, layout)
+        r2 = parse_exposition_layout(t2, self.NAMES, layout)
+        assert r1[0][1] is r2[0][1]
+
+    def test_churn_falls_back_then_recovers(self):
+        t1 = 'm{a="1"} 5\nm{a="2"} 6\n'
+        t2 = 'm{a="1"} 5\nm{a="3"} 6\nm{a="2"} 7\n'  # inserted series
+        t3 = 'm{a="3"} 1\n'                          # shrunk body
+        self._both([t1, t2, t2, t3, t1])
+
+    def test_comments_and_skipped_lines(self):
+        t = (
+            "# HELP m help\n# TYPE m gauge\n"
+            'm{a="1"} 1\n'
+            'skipped_metric{a="1"} 2\n'
+            "skipped_bare 3\n\n"
+        )
+        self._both([t, t])
+
+    def test_prefix_boundary_no_false_positive(self):
+        # "m" cached as a bare-name prefix must not claim "m2 1" (a
+        # DIFFERENT metric whose name merely extends the prefix).
+        t1 = "m 1\n"
+        t2 = "m2 1\n"
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache()
+        assert parse_exposition_layout(t1, self.NAMES, layout) == [("m", {}, 1.0)]
+        assert parse_exposition_layout(t2, self.NAMES, layout) == []
+
+    def test_timestamps_dropped_on_hit_path(self):
+        t1 = 'm{a="1"} 5 1700000000\n'
+        t2 = 'm{a="1"} 6 1700000001\n'
+        self._both([t1, t2])
+
+    def test_parse_error_leaves_cache_untouched(self):
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition_layout,
+        )
+
+        good = 'm{a="1"} 5\n'
+        layout = LayoutCache()
+        parse_exposition_layout(good, self.NAMES, layout)
+        entries_before = layout.entries
+        with pytest.raises(ParseError):
+            parse_exposition_layout('m{a="1"} not-a-number\n', self.NAMES, layout)
+        assert layout.entries is entries_before  # untouched
+        # And the good body still parses via the cache afterwards.
+        assert parse_exposition_layout(good, self.NAMES, layout) == [
+            ("m", {"a": "1"}, 5.0)
+        ]
+
+    def test_bad_value_on_cached_prefix_still_raises(self):
+        # A cached prefix whose VALUE goes malformed must raise like the
+        # reference parser, not silently skip.
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache()
+        parse_exposition_layout('m{a="1"} 5\n', self.NAMES, layout)
+        with pytest.raises(ParseError):
+            parse_exposition_layout('m{a="1"} zzz\n', self.NAMES, layout)
+
+    def test_escaped_labels_roundtrip(self):
+        t = 'm{a="q\\"uote",b="back\\\\slash\\n"} 5\n'
+        self._both([t, t])
+
+    def test_brace_corrupted_tail_on_warm_prefix_still_raises(self):
+        # Code-review r5 repro: two lines joined by a lost newline. The
+        # reference parser's rfind('}') picks the LATER brace and raises
+        # on the malformed block; a warm prefix hit must not silently
+        # accept the first sample and drop the second.
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache()
+        parse_exposition_layout('m{a="1"} 5\nm{a="2"} 6\n', self.NAMES, layout)
+        with pytest.raises(ParseError):
+            parse_exposition_layout(
+                'm{a="1"} 5 m{a="2"} 6\n', self.NAMES, layout
+            )

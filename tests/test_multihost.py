@@ -164,9 +164,18 @@ class TestAggregatorAtSliceScale:
         agg = SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages))
         t0 = time.perf_counter()
         agg.poll_once()
-        dt = time.perf_counter() - t0
+        cold = time.perf_counter() - t0
         snap = store.current()
         key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
         assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
         assert snap.value("tpu_slice_hosts_reporting", key) == 64.0
-        assert dt < 10.0, f"aggregator round took {dt:.2f}s at 64x256 chips"
+        assert cold < 10.0, f"cold aggregator round took {cold:.2f}s at 64x256"
+        # Steady state: the per-target layout cache re-parses values only
+        # (~0.34 s measured — bench_aggregate.py / BASELINE.md); the round-5
+        # guard locks that fast path in with headroom for slow CI machines.
+        t0 = time.perf_counter()
+        agg.poll_once()
+        warm = time.perf_counter() - t0
+        snap = store.current()
+        assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
+        assert warm < 3.0, f"warm aggregator round took {warm:.2f}s at 64x256"

@@ -253,3 +253,98 @@ class TestHistogramInvariants:
         # the number of observations <= bound.
         for s, bound in zip(buckets[:-1], spec.buckets):
             assert s.value == sum(1 for v in observations if v <= bound)
+
+
+class TestLayoutParserDifferential:
+    """parse_exposition_layout must agree with parse_exposition on EVERY
+    body — including corrupted ones — through any warm/cold cache state
+    (code-review r5: the hit path once accepted brace-corrupted lines the
+    reference parser rejects)."""
+
+    _names = st.sampled_from(["m", "tpu_x", "other", "sk"])
+    _line = st.one_of(
+        # well-formed samples, labeled and bare, with/without timestamps
+        st.tuples(
+            _names,
+            st.lists(
+                st.tuples(
+                    st.sampled_from(["a", "b", "host"]),
+                    st.text(
+                        alphabet=st.characters(
+                            blacklist_categories=("Cs",),
+                            blacklist_characters='\x00"\\\n',
+                        ),
+                        max_size=8,
+                    ),
+                ),
+                max_size=3,
+            ),
+            st.floats(allow_nan=False, width=32),
+            st.booleans(),
+        ).map(
+            lambda t: (
+                t[0]
+                + (
+                    "{"
+                    + ",".join(f'{k}="{v}"' for k, v in t[1])
+                    + "}"
+                    if t[1]
+                    else ""
+                )
+                + f" {t[2]!r}"
+                + (" 1700000000" if t[3] else "")
+            )
+        ),
+        # comments / blanks
+        st.sampled_from(["# HELP m h", "# TYPE m gauge", "", "# EOF"]),
+        # junk/corruption shapes (incl. the brace-in-tail repro)
+        st.sampled_from(
+            [
+                'm{a="1"} 5 m{a="2"} 6',
+                "m",
+                'm{a="x} 1',
+                "m2 1",
+                'tpu_x 5 {oops} 1',
+                "m nope",
+            ]
+        ),
+    )
+
+    @given(bodies=st.lists(st.lists(_line, max_size=12), min_size=1, max_size=4))
+    @settings(max_examples=150, deadline=None)
+    def test_layout_parser_matches_reference_through_any_cache_state(
+        self, bodies
+    ):
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition,
+            parse_exposition_layout,
+        )
+
+        names = frozenset({"m", "tpu_x"})
+        layout = LayoutCache()
+        for lines in bodies:
+            text = "\n".join(lines) + "\n"
+            try:
+                want = [
+                    (s.name, s.labels, s.value)
+                    for s in parse_exposition(text, names=names)
+                ]
+                want_err = None
+            except ParseError as e:
+                want, want_err = None, e
+            if want_err is None:
+                got = parse_exposition_layout(text, names, layout)
+                assert got == want, text
+            else:
+                entries_before = layout.entries
+                try:
+                    parse_exposition_layout(text, names, layout)
+                except ParseError:
+                    pass
+                else:
+                    raise AssertionError(
+                        f"layout parser accepted what reference rejects: {text!r}"
+                    )
+                assert layout.entries is entries_before  # cache untouched

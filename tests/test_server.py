@@ -616,7 +616,18 @@ def test_scrape_rejects_surface_as_self_metric():
         base = f"http://127.0.0.1:{app.port}"
         assert b"tpu_exporter_scrape_rejects_total 0\n" in get(base + "/metrics")[2]
         app.server.scrape_rejects[0] = 3  # as the guard would under a storm
-        app.collector.poll_once()
-        assert b"tpu_exporter_scrape_rejects_total 3\n" in get(base + "/metrics")[2]
+        # Retry: the CollectorLoop's startup poll may still be in flight and
+        # swap an older (rejects=0) snapshot AFTER our manual poll.
+        import time
+
+        deadline = time.monotonic() + 5.0
+        body = b""
+        while time.monotonic() < deadline:
+            app.collector.poll_once()
+            body = get(base + "/metrics")[2]
+            if b"tpu_exporter_scrape_rejects_total 3\n" in body:
+                break
+            time.sleep(0.05)
+        assert b"tpu_exporter_scrape_rejects_total 3\n" in body
     finally:
         app.stop()
