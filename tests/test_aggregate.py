@@ -631,6 +631,57 @@ class TestAggregateHonesty:
         )
 
 
+class TestAggregatorHistograms:
+    def test_round_and_scrape_histograms_exposed_and_om_valid(self):
+        from prometheus_client.openmetrics.parser import (
+            text_string_to_metric_families as om_parse,
+        )
+
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            tuple(pages), store, fetch=StaticFetch(pages)
+        )
+        agg.poll_once()
+        agg.poll_once()
+        agg.poll_once()
+        agg.close()
+        om = store.current().encode_openmetrics().decode()
+        fams = {f.name: f for f in om_parse(om)}
+        scr = fams["tpu_aggregator_target_scrape_seconds"]
+        assert scr.type == "histogram"
+        count = next(
+            s.value for s in scr.samples if s.name.endswith("_count")
+        )
+        assert count == 3.0  # one target x three rounds
+        rnd = fams["tpu_aggregator_round_seconds"]
+        assert rnd.type == "histogram"
+        # Round durations observe after the swap: snapshot 3 carries 2.
+        rcount = next(
+            s.value for s in rnd.samples if s.name.endswith("_count")
+        )
+        assert rcount == 2.0
+
+    def test_failed_scrapes_excluded_from_scrape_histogram(self):
+        # A down target's timeout duration must not pollute the pooled
+        # latency distribution (it would pin p99 at the top bucket).
+        pages = {"up:8000": make_host_text(0), "down:8000": ""}
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            tuple(pages), store,
+            fetch=StaticFetch(pages, down={"down:8000"}),
+        )
+        agg.poll_once()
+        agg.poll_once()
+        agg.close()
+        text = store.current().encode().decode()
+        (count_line,) = [
+            l for l in text.splitlines()
+            if l.startswith("tpu_aggregator_target_scrape_seconds_count")
+        ]
+        assert float(count_line.split()[-1]) == 2.0  # up target only, 2 rounds
+
+
 class TestMultisliceRollups:
     """Cross-slice (multi-slice group) rollups joined via tpu_host_info
     (BASELINE config 5: 2x v5p-128 over DCN)."""

@@ -29,7 +29,12 @@ import urllib.request
 from concurrent.futures import ThreadPoolExecutor
 
 from tpu_pod_exporter.collector import CollectorLoop
-from tpu_pod_exporter.metrics import CounterStore, SnapshotBuilder, SnapshotStore
+from tpu_pod_exporter.metrics import (
+    CounterStore,
+    HistogramStore,
+    SnapshotBuilder,
+    SnapshotStore,
+)
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.parse import (
     LayoutCache,
@@ -173,6 +178,12 @@ class SliceAggregator:
         self._parse_layouts: dict[str, LayoutCache] = {
             t: LayoutCache() for t in targets
         }
+        # Latency distributions (same contract as the exporter's: p99
+        # computable from the exposition). Round durations observe after
+        # the swap, so they land one round behind — fine for cumulative
+        # histograms.
+        self._round_hist = HistogramStore(schema.TPU_AGG_ROUND_HIST)
+        self._scrape_hist = HistogramStore(schema.TPU_AGG_TARGET_SCRAPE_HIST)
         self._pool = ThreadPoolExecutor(
             max_workers=min(len(targets), 16),
             thread_name_prefix="tpu-agg-scrape",
@@ -233,6 +244,12 @@ class SliceAggregator:
                 )
             b.add(schema.TPU_AGG_TARGET_UP, 1.0 if ok else 0.0, (target,))
             b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
+            if text is not None:
+                # Successful fetches only: a down target's timeout (~2 s
+                # every round) would pin the pooled p99 at the top bucket
+                # and mask regressions on healthy targets; failures are
+                # visible via target_up / scrape_errors instead.
+                self._scrape_hist.observe(duration_s)
 
         for key, agg in slices.items():
             # Mixed-fleet diagnostic (advisor r4): an exporter older than the
@@ -336,12 +353,17 @@ class SliceAggregator:
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
+        self._round_hist.emit(b)
+        self._scrape_hist.emit(b)
         if round_started is not None:
-            b.add(
-                schema.TPU_AGG_ROUND_DURATION_SECONDS,
-                time.monotonic() - round_started,
-            )
+            # One measurement for both the gauge and the histogram, so
+            # histogram_quantile cross-checks against the gauge instead of
+            # mysteriously exceeding it by the build+swap span.
+            round_dur = time.monotonic() - round_started
+            b.add(schema.TPU_AGG_ROUND_DURATION_SECONDS, round_dur)
         self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
+        if round_started is not None:
+            self._round_hist.observe(round_dur)
 
     @staticmethod
     def _consume(samples, slices, workloads, slice_groups) -> None:

@@ -521,6 +521,21 @@ TPU_AGG_ROUND_DURATION_SECONDS = MetricSpec(
     type=GAUGE,
 )
 
+# Distribution companions (same rationale as the exporter's histograms:
+# a p99 must be computable from the exposition alone). Distinct base names
+# from the point-in-time gauges above — one exposition name, one type.
+TPU_AGG_ROUND_HIST = HistogramSpec(
+    name="tpu_aggregator_round_seconds",
+    help="Distribution of full aggregation round durations since start.",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
+TPU_AGG_TARGET_SCRAPE_HIST = HistogramSpec(
+    name="tpu_aggregator_target_scrape_seconds",
+    help="Distribution of SUCCESSFUL per-target scrape durations since start, pooled across targets (failures/timeouts are excluded — see tpu_aggregator_target_up and _scrape_errors_total).",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
 AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_SLICE_HOSTS_REPORTING,
     TPU_SLICE_CHIP_COUNT,
