@@ -295,6 +295,87 @@ def reference_fsdp(w, x, y, lr: float = 0.1):
     return w - lr * g, loss
 
 
+# ------------------------------------------------------------- multi-slice
+
+def make_2d_mesh(
+    n_slices: int,
+    per_slice: int,
+    axes: tuple[str, str] = ("slice", "intra"),
+    platform: str | None = None,
+):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    from tpu_pod_exporter.loadgen.sharded import pick_devices
+
+    devs = pick_devices(n_slices * per_slice, platform=platform)
+    return Mesh(np.array(devs).reshape(n_slices, per_slice), axis_names=axes)
+
+
+def multislice_step_fn(mesh, slice_axis: str = "slice",
+                       tp_axis: str = "intra", lr: float = 0.1):
+    """Cross-slice data parallelism × intra-slice tensor parallelism over a
+    2D mesh — BASELINE config 5's compute shape (2 TPU slices cooperating
+    over DCN). The batch row-shards across slices and the weight
+    column-shards within each slice; the backward pass's gradient ``psum``
+    over ``slice_axis`` is the cross-slice (DCN-class) collective and the
+    loss ``psum`` over ``tp_axis`` the intra-slice (ICI-class) one — each
+    mesh axis maps to one fabric, exactly the split the exporter's
+    ``tpu_ici_*`` / ``tpu_dcn_*`` families observe.
+
+    Returns ``(fn, w_sharding, x_sharding)``; ``fn(w, x) -> (new_w,
+    loss)`` with w column-sharded over tp (replicated across slices) and x
+    batch-sharded across slices (replicated within one).
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def local(w_shard, x_shard):
+        # w_shard: (d, d/tp); x_shard: (b/slices, d).
+        def local_loss(ws):
+            y = x_shard @ ws
+            return jnp.sum(y * y)
+
+        part, g = jax.value_and_grad(local_loss)(w_shard)
+        # The cross-slice (DCN) gradient all-reduce is already IN g:
+        # w_shard is replicated over slice_axis while x_shard varies over
+        # it, so transposing that use makes jax insert psum(·, slice_axis)
+        # on the cotangent to keep it replicated like its primal — the
+        # same transpose rule the FSDP program's reduce_scatter comment
+        # documents. An explicit psum here would double-count (measured:
+        # exactly n_slices× the dense gradient).
+        # The global loss crosses BOTH fabrics explicitly: column shards
+        # (ICI-class, tp_axis) and batch shards (DCN-class, slice_axis).
+        loss = lax.psum(part, (tp_axis, slice_axis))
+        return w_shard - lr * g, loss
+
+    sm = _shard_map()
+    fn = sm(local, mesh=mesh,
+            in_specs=(P(None, tp_axis), P(slice_axis, None)),
+            out_specs=(P(None, tp_axis), P()))
+    return (
+        jax.jit(fn),
+        NamedSharding(mesh, P(None, tp_axis)),
+        NamedSharding(mesh, P(slice_axis, None)),
+    )
+
+
+def reference_multislice(w, x, lr: float = 0.1):
+    """Dense single-device step — ground truth for multislice_step_fn
+    (highest-precision dots; see reference_attention)."""
+    import jax
+    import jax.numpy as jnp
+
+    def loss_of(wf):
+        y = jnp.matmul(x, wf, precision="highest")
+        return jnp.sum(y * y)
+
+    loss, g = jax.value_and_grad(loss_of)(w)
+    return w - lr * g, loss
+
+
 # ------------------------------------------------------------------- dryrun
 
 def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
@@ -355,4 +436,19 @@ def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
     yb = jax.device_put(jnp.zeros((4 * n_devices, d_f), jnp.float32), w_sharding)
     _, loss = fn(w, xb, yb)
     results["fsdp"] = float(loss)
+
+    # Multi-slice: cross-slice dp × intra-slice tp over a 2D mesh (the
+    # BASELINE config-5 shape; gradients cross the DCN-class axis).
+    if n_devices >= 4 and n_devices % 2 == 0:
+        mesh = make_2d_mesh(2, n_devices // 2)
+        fn, w_sh, x_sh = multislice_step_fn(mesh)
+        d_ms = 2 * n_devices
+        w = jax.device_put(
+            jax.random.normal(key, (d_ms, d_ms), jnp.float32) * 0.3, w_sh
+        )
+        x = jax.device_put(
+            jax.random.normal(key, (8, d_ms), jnp.float32), x_sh
+        )
+        _, loss = fn(w, x)
+        results["multislice_dp_tp"] = float(loss)
     return results

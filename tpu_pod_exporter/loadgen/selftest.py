@@ -223,6 +223,40 @@ def check_fsdp(n: int) -> dict:
     }
 
 
+def check_multislice(n: int) -> dict:
+    """Cross-slice dp × intra-slice tp over a 2D mesh (2 slices × n/2)
+    must match the dense single-device SGD step — validates the gradient
+    psum over the DCN-class axis and the two-fabric loss reduction."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_2d_mesh,
+        multislice_step_fn,
+        reference_multislice,
+    )
+
+    if n < 4 or n % 2:
+        # Same mesh-size guard as run_parallelism_dryrun: the 2×(n/2) mesh
+        # needs an even device count, and d=2n must divide by tp=n/2.
+        return {"ok": True, "skipped": f"needs even n>=4, got {n}"}
+    mesh = make_2d_mesh(2, n // 2)
+    fn, w_sharding, x_sharding = multislice_step_fn(mesh)
+    d, b = 2 * n, 8
+    k1, k2 = jax.random.split(jax.random.PRNGKey(13), 2)
+    w = 0.3 * jax.random.normal(k1, (d, d), jnp.float32)
+    x = jax.random.normal(k2, (b, d), jnp.float32)
+    new_w, loss = fn(jax.device_put(w, w_sharding), jax.device_put(x, x_sharding))
+    ref_w, ref_loss = reference_multislice(w, x)
+    res = _close(new_w, ref_w, rtol=2e-4, atol=2e-4)
+    loss_err = abs(float(loss) - float(ref_loss)) / max(abs(float(ref_loss)), 1e-9)
+    return {
+        **res,
+        "ok": res["ok"] and loss_err < 1e-4,
+        "loss_rel_err": loss_err,
+    }
+
+
 def check_sharded_descends(n: int) -> dict:
     """SGD on a fixed batch must strictly descend over 5 steps."""
     import numpy as np
@@ -258,6 +292,7 @@ CHECKS = {
     "pipeline": check_pipeline,
     "moe": check_moe,
     "fsdp": check_fsdp,
+    "multislice": check_multislice,
     "sharded_descends": check_sharded_descends,
     "flagship": check_flagship,
 }
