@@ -33,6 +33,27 @@ class TestRoundtrip:
         restored = sample_from_dict(sample_to_dict(backend.sample()))
         assert restored.chips[0].tensorcore_duty_cycle_percent is None
 
+    def test_dcn_links_roundtrip(self):
+        # dcn_links was silently dropped by record/replay when added —
+        # the full-equality roundtrip above only covers DCN-less samples.
+        backend = FakeBackend(
+            chips=1,
+            script=FakeChipScript(
+                ici_link_count=1, ici_bytes_per_step=10,
+                dcn_link_count=2, dcn_bytes_per_step=7,
+            ),
+        )
+        original = backend.sample()
+        assert original.chips[0].dcn_links  # fixture sanity
+        restored = sample_from_dict(sample_to_dict(original))
+        assert restored == original
+
+    def test_dcn_key_omitted_without_dcn_links(self):
+        # Old replayers must not see an unknown key for DCN-less chips.
+        backend = FakeBackend(chips=1)
+        doc = sample_to_dict(backend.sample())
+        assert "dcn" not in doc["chips"][0]
+
 
 class TestRecordReplay:
     def test_record_then_replay(self, tmp_path):
@@ -207,3 +228,24 @@ class TestRealHardwareFixture:
         backend = RecordedBackend(str(self.FIXTURE), loop=False)
         for _ in range(lines):
             assert backend.sample().chips
+
+
+def test_structurally_wrong_value_reports_path_and_line(tmp_path):
+    # float() on a list / .items() on a scalar raise TypeError/AttributeError,
+    # which must surface as the documented BackendError with path:line, not
+    # a raw traceback (code-review r5).
+    import pytest
+
+    from tpu_pod_exporter.backend import BackendError
+    from tpu_pod_exporter.backend.recorded import RecordedBackend
+
+    for bad in (
+        '{"chips": [{"chip_id": 0, "hbm_used": 1, "hbm_total": 2, '
+        '"duty": null, "ici": {}, "dcn": {"0": [1, 2]}}]}',
+        '{"chips": [{"chip_id": 0, "hbm_used": 1, "hbm_total": 2, '
+        '"duty": null, "ici": 5}]}',
+    ):
+        p = tmp_path / "bad.jsonl"
+        p.write_text(bad + "\n")
+        with pytest.raises(BackendError, match="bad.jsonl:1"):
+            RecordedBackend(str(p))

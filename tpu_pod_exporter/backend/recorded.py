@@ -11,7 +11,7 @@ JSONL schema (one poll per line; optional keys are omitted when absent so
 old recordings replay unchanged):
     {"chips": [{"chip_id": 0, "device_path": "...", "device_ids": ["0"],
                 "hbm_used": N, "hbm_total": N, "duty": N|null,
-                "ici": {"0": N, ...},
+                "ici": {"0": N, ...}, "dcn": {"0": N, ...}?,
                 "peak": N?, "device_kind": "..."?, "coords": "..."?}, ...],
      "partial_errors": ["..."]}
 """
@@ -44,6 +44,10 @@ def sample_to_dict(sample: HostSample) -> dict:
             "duty": c.tensorcore_duty_cycle_percent,
             "ici": {l.link: l.transferred_bytes_total for l in c.ici_links},
         }
+        if c.dcn_links:  # omitted when absent: old recordings replay unchanged
+            doc["dcn"] = {
+                l.link: l.transferred_bytes_total for l in c.dcn_links
+            }
         if c.hbm_peak_bytes is not None:
             doc["peak"] = c.hbm_peak_bytes
         if c.info.device_kind:
@@ -84,6 +88,10 @@ def sample_from_dict(doc: dict) -> HostSample:
                 ),
                 hbm_peak_bytes=(
                     None if c.get("peak") is None else float(c["peak"])
+                ),
+                dcn_links=tuple(
+                    IciLinkSample(link=str(k), transferred_bytes_total=float(v))
+                    for k, v in sorted((c.get("dcn") or {}).items())
                 ),
             )
         )
@@ -134,7 +142,17 @@ class RecordedBackend(DeviceBackend):
                         continue
                     try:
                         self._samples.append(sample_from_dict(json.loads(line)))
-                    except (json.JSONDecodeError, KeyError, ValueError) as e:
+                    except (
+                        json.JSONDecodeError,
+                        KeyError,
+                        ValueError,
+                        # float()/.items() on a structurally wrong value
+                        # (e.g. "dcn": {"0": [1,2]} or "ici": 5) raise
+                        # TypeError/AttributeError — a corrupt record must
+                        # report path:line, not a raw traceback.
+                        TypeError,
+                        AttributeError,
+                    ) as e:
                         raise BackendError(f"{path}:{ln}: bad record: {e}") from e
         except OSError as e:
             raise BackendError(f"cannot read recording {path}: {e}") from e

@@ -13,8 +13,13 @@ metrics must observe):
   stage→stage via ``ppermute`` — directional neighbor traffic with bubbles.
 - **Expert parallel (MoE)**: tokens ``lax.all_to_all`` to their expert's
   device and back — the dense crossbar pattern.
+- **FSDP**: forward ``all_gather`` of the row-sharded weight; its transpose
+  lowers the weight gradient to ``reduce_scatter`` — the fan-in/fan-out pair.
+- **Multi-slice dp × tp** (2D mesh): cross-slice gradient all-reduce
+  (DCN-class axis) over intra-slice tensor parallelism (ICI-class axis) —
+  BASELINE config 5's compute shape.
 
-All three are ``jax.shard_map`` programs with compiler-visible collectives
+All five are ``jax.shard_map`` programs with compiler-visible collectives
 (no data-dependent Python control flow), verified numerically against their
 single-device references in ``tests/test_parallel.py`` on the virtual CPU
 mesh, and composed into the driver's multi-chip dry run
