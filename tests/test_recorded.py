@@ -48,6 +48,24 @@ class TestRoundtrip:
         restored = sample_from_dict(sample_to_dict(original))
         assert restored == original
 
+    def test_numeric_link_ids_replay_in_numeric_order(self):
+        # The live libtpu backend orders links numerically (_link_sort_key);
+        # a lexicographic replay would shuffle ids >= 10 and feed the
+        # collector's layout fast path a different sequence than the
+        # backend being reproduced (code-review r5).
+        doc = {
+            "chips": [{
+                "chip_id": 0, "hbm_used": 1.0, "hbm_total": 2.0,
+                "duty": None,
+                "ici": {str(i): float(i) for i in range(12)},
+                "dcn": {"10": 1.0, "2": 2.0, "dcnx": 3.0},
+            }]
+        }
+        chip = sample_from_dict(doc).chips[0]
+        assert [l.link for l in chip.ici_links] == [str(i) for i in range(12)]
+        # Numeric ids first (numerically), non-numeric after.
+        assert [l.link for l in chip.dcn_links] == ["2", "10", "dcnx"]
+
     def test_dcn_key_omitted_without_dcn_links(self):
         # Old replayers must not see an unknown key for DCN-less chips.
         backend = FakeBackend(chips=1)

@@ -186,6 +186,7 @@ class ExporterApp:
             worker_id=cfg.worker_id,
             multislice_group=cfg.multislice_group,
         )
+        self.topology = topo  # effective (detected) values, for /debug/vars
         scanner = None
         if cfg.process_metrics:
             from tpu_pod_exporter.procscan import ProcScanner
@@ -239,6 +240,12 @@ class ExporterApp:
                 "backend": getattr(self.backend, "name", "?"),
                 "attribution": getattr(self.attribution, "name", "?"),
                 "resource_name": self.cfg.resource_name,
+                "max_concurrent_scrapes": self.cfg.max_concurrent_scrapes,
+                "max_scrapes_per_s": self.cfg.max_scrapes_per_s,
+                # Effective (detected) membership, not the raw override —
+                # the GKE auto-detected case would otherwise show "".
+                "multislice_group": self.topology.multislice_group,
+                "num_slices": self.topology.num_slices,
             },
             "last_poll": {
                 "ok": stats.ok,

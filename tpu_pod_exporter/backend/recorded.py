@@ -30,6 +30,12 @@ from tpu_pod_exporter.backend import (
     HostSample,
     IciLinkSample,
 )
+# Same numeric-first link ordering the live libtpu backend emits: replay
+# must be ORDER-faithful too, or numeric ids >= 10 come back
+# lexicographically shuffled and the collector's layout fast path sees a
+# different link sequence than the backend being reproduced. (Safe import:
+# libtpu defers its grpc import to construction.)
+from tpu_pod_exporter.backend.libtpu import _link_sort_key
 
 
 def sample_to_dict(sample: HostSample) -> dict:
@@ -84,14 +90,18 @@ def sample_from_dict(doc: dict) -> HostSample:
                 ),
                 ici_links=tuple(
                     IciLinkSample(link=str(k), transferred_bytes_total=float(v))
-                    for k, v in sorted((c.get("ici") or {}).items())
+                    for k, v in sorted(
+                        (c.get("ici") or {}).items(), key=_link_sort_key
+                    )
                 ),
                 hbm_peak_bytes=(
                     None if c.get("peak") is None else float(c["peak"])
                 ),
                 dcn_links=tuple(
                     IciLinkSample(link=str(k), transferred_bytes_total=float(v))
-                    for k, v in sorted((c.get("dcn") or {}).items())
+                    for k, v in sorted(
+                        (c.get("dcn") or {}).items(), key=_link_sort_key
+                    )
                 ),
             )
         )

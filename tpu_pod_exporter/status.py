@@ -200,6 +200,8 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
             "slice_name": topo.slice_name,
             "host": topo.host,
             "worker_id": topo.worker_id,
+            "multislice_group": topo.multislice_group,
+            "num_slices": topo.num_slices,
             "chips": doc_chips,
             # Machine-readable too, not just the stderr warnings: an
             # hbm_used_bytes of null is only diagnosable with these.
