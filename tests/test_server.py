@@ -389,7 +389,7 @@ class TestScrapeConcurrencyGuard:
             assert b"too many" in body
             # ...while non-scrape endpoints stay unguarded.
             assert get(base + "/healthz")[0] == 200
-            assert server.scrape_rejects[0] == 1
+            assert server.scrape_rejects["concurrency"] == 1
             # Release the holders: both complete fine.
             release.release(2)
             for t in holders:
@@ -463,7 +463,7 @@ class TestScrapeConcurrencyGuard:
             for t in threads:
                 t.join(timeout=10)
             assert statuses.count(429) == n
-            assert server.scrape_rejects[0] == n
+            assert server.scrape_rejects["concurrency"] == n
         finally:
             release.release(4)
             holder.join(timeout=5)
@@ -504,7 +504,7 @@ class TestScrapeRateCap:
             statuses = [get(base + "/metrics")[0] for _ in range(30)]
             assert statuses[0] == 200           # bucket starts full
             assert statuses.count(429) >= 10    # the wall is real
-            assert server.scrape_rejects[0] == statuses.count(429)
+            assert server.scrape_rejects["rate"] == statuses.count(429)
             # Refill: at 5/s, one token comes back well within a second.
             time.sleep(0.5)
             assert get(base + "/metrics")[0] == 200
@@ -614,8 +614,10 @@ def test_scrape_rejects_surface_as_self_metric():
     app.start()
     try:
         base = f"http://127.0.0.1:{app.port}"
-        assert b"tpu_exporter_scrape_rejects_total 0\n" in get(base + "/metrics")[2]
-        app.server.scrape_rejects[0] = 3  # as the guard would under a storm
+        body0 = get(base + "/metrics")[2]
+        assert b'tpu_exporter_scrape_rejects_total{cause="concurrency"} 0\n' in body0
+        assert b'tpu_exporter_scrape_rejects_total{cause="rate"} 0\n' in body0
+        app.server.scrape_rejects["rate"] = 3  # as the guard would under a storm
         # Retry: the CollectorLoop's startup poll may still be in flight and
         # swap an older (rejects=0) snapshot AFTER our manual poll.
         import time
@@ -625,9 +627,9 @@ def test_scrape_rejects_surface_as_self_metric():
         while time.monotonic() < deadline:
             app.collector.poll_once()
             body = get(base + "/metrics")[2]
-            if b"tpu_exporter_scrape_rejects_total 3\n" in body:
+            if b'tpu_exporter_scrape_rejects_total{cause="rate"} 3\n' in body:
                 break
             time.sleep(0.05)
-        assert b"tpu_exporter_scrape_rejects_total 3\n" in body
+        assert b'tpu_exporter_scrape_rejects_total{cause="rate"} 3\n' in body
     finally:
         app.stop()

@@ -211,7 +211,7 @@ class ExporterApp:
             process_scanner=scanner,
             # Deferred attribute read: self.server is constructed below;
             # the first poll (in start()) runs after __init__ completes.
-            scrape_rejects_fn=lambda: self.server.scrape_rejects[0],
+            scrape_rejects_fn=lambda: dict(self.server.scrape_rejects),
             scrape_duration_hist=scrape_hist,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
@@ -260,7 +260,7 @@ class ExporterApp:
             "loop_overruns": self.loop.overruns,
             "series": snap.series_count,
             "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
-            "scrape_rejects": self.server.scrape_rejects[0],
+            "scrape_rejects": dict(self.server.scrape_rejects),
         }
         if self.process_scanner is not None:
             out["process_scanner"] = {

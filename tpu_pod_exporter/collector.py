@@ -77,7 +77,7 @@ class Collector:
         attribution_max_stale_s: float = 30.0,
         legacy_metrics: bool = False,
         process_scanner=None,
-        scrape_rejects_fn=None,  # () -> int, from the HTTP guard
+        scrape_rejects_fn=None,  # () -> {cause: int}, from the HTTP guard
         scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
         clock=time.monotonic,
         wallclock=time.time,
@@ -567,10 +567,12 @@ class Collector:
             b.add(schema.TPU_EXPORTER_RSS_BYTES, rss)
         if self._scrape_rejects_fn is not None:
             try:
-                b.add(
-                    schema.TPU_EXPORTER_SCRAPE_REJECTS_TOTAL,
-                    float(self._scrape_rejects_fn()),
-                )
+                for cause, n in self._scrape_rejects_fn().items():
+                    b.add(
+                        schema.TPU_EXPORTER_SCRAPE_REJECTS_TOTAL,
+                        float(n),
+                        (cause,),
+                    )
             except Exception:  # noqa: BLE001 — accounting must never fail a poll
                 pass
 

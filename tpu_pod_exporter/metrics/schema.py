@@ -282,8 +282,9 @@ TPU_EXPORTER_RSS_BYTES = MetricSpec(
 
 TPU_EXPORTER_SCRAPE_REJECTS_TOTAL = MetricSpec(
     name="tpu_exporter_scrape_rejects_total",
-    help="Scrapes rejected with 429 by the /metrics concurrency guard or rate cap since start.",
+    help="Scrapes rejected with 429 since start, by cause: 'concurrency' (too many in-flight renders: slow scrapers or too many of them) vs 'rate' (token bucket: scraping too often). The fixes differ, so the counter splits.",
     type=COUNTER,
+    label_names=("cause",),
 )
 
 TPU_EXPORTER_INFO = MetricSpec(

@@ -150,7 +150,7 @@ class _Handler(BaseHTTPRequestHandler):
     # threads (overflow rejects immediately).
     scrape_tarpit_s: float = 0.1
     tarpit_slots: threading.BoundedSemaphore | None = None
-    scrape_rejects = None  # [int] mutable cell, shared per server
+    scrape_rejects = None  # {"concurrency": int, "rate": int}, shared per server
     scrape_rejects_lock: threading.Lock | None = None
     # Optional (duration_s: float) -> None, called for every SERVED scrape
     # (rejects excluded — a tarpit sleep is not a scrape latency). Feeds the
@@ -205,7 +205,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _serve_metrics(self) -> None:
         bucket = self.scrape_bucket
         if bucket is not None and not bucket.take():
-            self._reject_scrape(tarpit=True)
+            self._reject_scrape("rate", tarpit=True)
             return
         sem = self.scrape_sem
         if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
@@ -213,7 +213,7 @@ class _Handler(BaseHTTPRequestHandler):
                 bucket.refund()  # this scrape was never served
             # No tarpit here: this path already queued for
             # scrape_queue_timeout_s, which throttles the client the same way.
-            self._reject_scrape()
+            self._reject_scrape("concurrency")
             return
         try:
             t0 = time.perf_counter()
@@ -225,7 +225,7 @@ class _Handler(BaseHTTPRequestHandler):
             if sem is not None:
                 sem.release()
 
-    def _reject_scrape(self, tarpit: bool = False) -> None:
+    def _reject_scrape(self, cause: str, tarpit: bool = False) -> None:
         if tarpit and self.scrape_tarpit_s > 0:
             slots = self.tarpit_slots
             if slots is not None and slots.acquire(blocking=False):
@@ -234,12 +234,12 @@ class _Handler(BaseHTTPRequestHandler):
                 finally:
                     slots.release()
         if self.scrape_rejects is not None:
-            # += on a list cell is a read-modify-write, NOT GIL-atomic;
+            # += on a dict value is a read-modify-write, NOT GIL-atomic;
             # under the very storm this counts, unlocked increments drop
             # (advisor r4). The reject path is already slow-path — a
             # lock costs nothing here.
             with self.scrape_rejects_lock:
-                self.scrape_rejects[0] += 1
+                self.scrape_rejects[cause] += 1
         self.close_connection = True
         self.wfile.write(_REJECT_RESPONSE)
 
@@ -303,7 +303,9 @@ class MetricsServer:
         scrape_tarpit_s: float = 0.1,
         scrape_observer=None,
     ) -> None:
-        self.scrape_rejects = [0]
+        # Both causes pre-seeded so the self-metric publishes a 0 series
+        # per cause from poll 1 (stable surface).
+        self.scrape_rejects = {"concurrency": 0, "rate": 0}
         handler = type(
             "BoundHandler",
             (_Handler,),
