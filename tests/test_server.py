@@ -1,5 +1,6 @@
 """HTTP surface tests: real sockets, scrape semantics (SURVEY.md §4.3)."""
 
+import contextlib
 import gzip
 import urllib.request
 
@@ -321,104 +322,117 @@ class TestAcceptParsing:
         assert acc("application/openmetrics-text;q=abc") is True
 
 
+def blocking_store(release, entered):
+    """A store whose snapshots block inside encode() until released —
+    holds handler threads inside the guarded section deterministically."""
+    store = SnapshotStore()
+    put_snapshot(store, 7)
+    real = store.current()
+
+    class BlockingSnapshot:
+        timestamp = real.timestamp
+        series_count = real.series_count
+
+        @staticmethod
+        def encode():
+            entered.release()
+            release.acquire()
+            return real.encode()
+
+        encode_openmetrics = encode
+        encode_gzip = encode
+        encode_openmetrics_gzip = encode
+
+    class BlockingStore:
+        @staticmethod
+        def current():
+            return BlockingSnapshot
+
+    return BlockingStore()
+
+
+class HeldServer:
+    __slots__ = ("server", "base", "release", "holders", "holder_results")
+
+    def __init__(self, server, base, release, holders, holder_results):
+        self.server = server
+        self.base = base
+        self.release = release
+        self.holders = holders
+        self.holder_results = holder_results
+
+    def free_holders(self):
+        """Release the held scrapes and WAIT for them to finish — callers
+        asserting a post-release 200 must not race the holder threads out
+        of their slots. Generous release count: every LATER scrape against
+        the blocking store also consumes one permit in encode()."""
+        self.release.release(64)
+        for t in self.holders:
+            t.join(timeout=5)
+
+
+@contextlib.contextmanager
+def held_server(n_holders: int = 1, **server_kwargs):
+    """A MetricsServer with `n_holders` scrapes deterministically held
+    inside the guarded render (the context cleans up regardless)."""
+    import threading
+
+    release = threading.Semaphore(0)
+    entered = threading.Semaphore(0)
+    server = MetricsServer(
+        blocking_store(release, entered), host="127.0.0.1", port=0,
+        **server_kwargs,
+    )
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    results: list[int] = []
+    holders = [
+        threading.Thread(target=lambda: results.append(get(base + "/metrics")[0]))
+        for _ in range(n_holders)
+    ]
+    for t in holders:
+        t.start()
+    for _ in holders:
+        assert entered.acquire(timeout=5)  # each holder is INSIDE the render
+    try:
+        yield HeldServer(server, base, release, holders, results)
+    finally:
+        release.release(64)
+        entered.release(64)
+        for t in holders:
+            t.join(timeout=5)
+        server.stop()
+
+
 class TestScrapeConcurrencyGuard:
     """VERDICT r3 #8: a scrape storm must hit a 429 wall, not eat a core.
     At most N /metrics handlers run at once; the N+1th queues briefly and
     is rejected with Retry-After."""
 
-    def _blocking_store(self, release, entered):
-        """A store whose snapshots block inside encode() until released —
-        holds handler threads inside the guarded section deterministically."""
-        import threading
-
-        store = SnapshotStore()
-        put_snapshot(store, 7)
-        real = store.current()
-
-        class BlockingSnapshot:
-            timestamp = real.timestamp
-            series_count = real.series_count
-
-            @staticmethod
-            def encode():
-                entered.release()
-                release.acquire()
-                return real.encode()
-
-            encode_openmetrics = encode
-            encode_gzip = encode
-            encode_openmetrics_gzip = encode
-
-        class BlockingStore:
-            @staticmethod
-            def current():
-                return BlockingSnapshot
-
-        return BlockingStore()
-
     def test_excess_scrapes_get_429(self):
-        import threading
-        import urllib.error
-
-        release = threading.Semaphore(0)
-        entered = threading.Semaphore(0)
-        store = self._blocking_store(release, entered)
-        server = MetricsServer(
-            store, host="127.0.0.1", port=0,
-            max_concurrent_scrapes=2, scrape_queue_timeout_s=0.1,
-        )
-        server.start()
-        base = f"http://127.0.0.1:{server.port}"
-        results = []
-
-        def scrape():
-            results.append(get(base + "/metrics")[0])
-
-        try:
-            holders = [threading.Thread(target=scrape) for _ in range(2)]
-            for t in holders:
-                t.start()
-            # Wait until both holders are INSIDE the guarded render.
-            for _ in range(2):
-                assert entered.acquire(timeout=5)
-            # Slots are full: the next scrape must be rejected after the
-            # queue timeout...
-            status, headers, body = get(base + "/metrics")
+        # TWO slots, both held: N concurrent scrapes up to the limit must
+        # all serve (guards against an off-by-one in the semaphore), and
+        # the N+1th must hit the wall.
+        with held_server(
+            n_holders=2, max_concurrent_scrapes=2, scrape_queue_timeout_s=0.1
+        ) as h:
+            status, headers, body = get(h.base + "/metrics")
             assert status == 429
             assert headers["Retry-After"] == "1"
             assert b"too many" in body
             # ...while non-scrape endpoints stay unguarded.
-            assert get(base + "/healthz")[0] == 200
-            assert server.scrape_rejects["concurrency"] == 1
-            # Release the holders: both complete fine.
-            release.release(2)
-            for t in holders:
-                t.join(timeout=5)
-            assert results == [200, 200]
-            # And the slots are free again.
-            entered.release(2)  # encode() no longer needs to signal
-            release.release(2)
-            assert get(base + "/metrics")[0] == 200
-        finally:
-            release.release(8)
-            server.stop()
+            assert get(h.base + "/healthz")[0] == 200
+            assert h.server.scrape_rejects["concurrency"] == 1
+            # Release the holders: both complete fine and slots free up.
+            h.free_holders()
+            assert h.holder_results == [200, 200]
+            assert get(h.base + "/metrics")[0] == 200
 
     def test_reject_is_prerendered_and_closes_connection(self):
-        import threading
-
-        release = threading.Semaphore(0)
-        entered = threading.Semaphore(0)
-        store = self._blocking_store(release, entered)
-        server = MetricsServer(
-            store, host="127.0.0.1", port=0,
-            max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05,
-        )
-        server.start()
-        base = f"http://127.0.0.1:{server.port}"
-        try:
-            holder = threading.Thread(target=lambda: get(base + "/metrics"))
-            holder.start()
-            assert entered.acquire(timeout=5)
+        with held_server(
+            max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05
+        ) as h:
+            base = h.base
             status, headers, body = get(base + "/metrics")
             assert status == 429
             # The pre-rendered wire bytes must still be a valid HTTP
@@ -427,10 +441,6 @@ class TestScrapeConcurrencyGuard:
             assert headers["Connection"] == "close"
             assert int(headers["Content-Length"]) == len(body)
             assert body == b"too many concurrent scrapes\n"
-        finally:
-            release.release(4)
-            holder.join(timeout=5)
-            server.stop()
 
     def test_concurrent_rejects_count_exactly(self):
         # Advisor r4: the reject increment is lock-guarded — N concurrent
@@ -438,24 +448,15 @@ class TestScrapeConcurrencyGuard:
         # very storm the counter exists to measure.
         import threading
 
-        release = threading.Semaphore(0)
-        entered = threading.Semaphore(0)
-        store = self._blocking_store(release, entered)
-        server = MetricsServer(
-            store, host="127.0.0.1", port=0,
-            max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05,
-        )
-        server.start()
-        base = f"http://127.0.0.1:{server.port}"
-        statuses = []
+        with held_server(
+            max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05
+        ) as h:
+            server, base = h.server, h.base
+            statuses = []
 
-        def scrape():
-            statuses.append(get(base + "/metrics")[0])
+            def scrape():
+                statuses.append(get(base + "/metrics")[0])
 
-        try:
-            holder = threading.Thread(target=lambda: get(base + "/metrics"))
-            holder.start()
-            assert entered.acquire(timeout=5)
             n = 24
             threads = [threading.Thread(target=scrape) for _ in range(n)]
             for t in threads:
@@ -464,10 +465,6 @@ class TestScrapeConcurrencyGuard:
                 t.join(timeout=10)
             assert statuses.count(429) == n
             assert server.scrape_rejects["concurrency"] == n
-        finally:
-            release.release(4)
-            holder.join(timeout=5)
-            server.stop()
 
     def test_guard_disabled_with_zero(self):
         store = SnapshotStore()
@@ -543,35 +540,19 @@ class TestScrapeRateCap:
         # never served, so it must not count against the rate — a stall
         # would otherwise drain the bucket and 429 well-behaved scrapers
         # after it clears.
-        import threading
-
-        release = threading.Semaphore(0)
-        entered = threading.Semaphore(0)
-        store = TestScrapeConcurrencyGuard()._blocking_store(release, entered)
-        server = MetricsServer(
-            store, host="127.0.0.1", port=0,
+        with held_server(
             max_concurrent_scrapes=1, scrape_queue_timeout_s=0.05,
             max_scrapes_per_s=5.0, scrape_tarpit_s=0.0,
-        )
-        server.start()
-        base = f"http://127.0.0.1:{server.port}"
-        try:
-            holder = threading.Thread(target=lambda: get(base + "/metrics"))
-            holder.start()
-            assert entered.acquire(timeout=5)
+        ) as h:
             # 8 sem-rejects; each took then refunded a token (burst is 10,
             # and the holder itself consumed 1).
             for _ in range(8):
-                assert get(base + "/metrics")[0] == 429
-            release.release(16)
-            entered.release(16)
-            holder.join(timeout=5)
-            # Bucket must still hold ~9 tokens: 8 quick scrapes all serve.
-            statuses = [get(base + "/metrics")[0] for _ in range(8)]
+                assert get(h.base + "/metrics")[0] == 429
+            # Free the holder's slot (joined, so no race on the slot);
+            # the bucket must still hold ~9 tokens: 8 quick scrapes serve.
+            h.free_holders()
+            statuses = [get(h.base + "/metrics")[0] for _ in range(8)]
             assert statuses == [200] * 8
-        finally:
-            release.release(16)
-            server.stop()
 
     def test_rate_cap_disabled_by_default(self):
         store = SnapshotStore()

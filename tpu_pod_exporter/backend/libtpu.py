@@ -485,21 +485,11 @@ class LibtpuMetricsBackend(DeviceBackend):
         all_numeric = all(d.isdigit() for d in ordered)
         for pos, dev_id in enumerate(ordered):
             idx = int(dev_id) if all_numeric else pos
-            links = ()
-            if dev_id in ici:
-                # Per-link rows when the runtime serves a link attribute
-                # (link id order stabilized for the collector's layout
-                # fast-path); a single aggregate row degrades to link="all".
-                links = tuple(
-                    IciLinkSample(link=lk, transferred_bytes_total=v)
-                    for lk, v in sorted(ici[dev_id].items(), key=_link_sort_key)
-                )
-            dcn_links = ()
-            if dev_id in dcn:
-                dcn_links = tuple(
-                    IciLinkSample(link=lk, transferred_bytes_total=v)
-                    for lk, v in sorted(dcn[dev_id].items(), key=_link_sort_key)
-                )
+            # Per-link rows when the runtime serves a link attribute (link
+            # id order stabilized for the collector's layout fast-path); a
+            # single aggregate row degrades to link="all".
+            links = _links_from_rows(ici.get(dev_id))
+            dcn_links = _links_from_rows(dcn.get(dev_id))
             chips.append(
                 ChipSample(
                     info=ChipInfo(
@@ -529,6 +519,17 @@ class LibtpuMetricsBackend(DeviceBackend):
 
     def close(self) -> None:
         self._reset_channel()
+
+
+def _links_from_rows(rows: dict[str, float] | None) -> tuple:
+    """{link id: counter} rows for one device → sorted IciLinkSample tuple
+    (numeric-first order — shared by the ICI and DCN paths)."""
+    if not rows:
+        return ()
+    return tuple(
+        IciLinkSample(link=lk, transferred_bytes_total=v)
+        for lk, v in sorted(rows.items(), key=_link_sort_key)
+    )
 
 
 def _dev_sort_key(dev_id: str):
