@@ -608,3 +608,20 @@ class TestPodRollupHonesty:
         snap = store.current()
         assert snap.value("tpu_hbm_total_bytes", chip_labels(0)) == 0.0
         assert snap.value("tpu_hbm_used_percent", chip_labels(0)) is None
+
+
+class TestOverrunsExported:
+    def test_loop_overruns_reach_exposition(self, store):
+        c = make_collector(
+            FakeBackend(chips=1), FakeAttribution(), store,
+            loop_overruns_fn=lambda: 7,
+        )
+        c.poll_once()
+        assert store.current().value("tpu_exporter_poll_overruns_total") == 7.0
+
+    def test_absent_without_a_loop(self, store):
+        # One-shot tools (status, hwcheck) have no loop: no overruns series.
+        c = make_collector(FakeBackend(chips=1), FakeAttribution(), store)
+        c.poll_once()
+        text = store.current().encode().decode()
+        assert "\ntpu_exporter_poll_overruns_total " not in text

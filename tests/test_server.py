@@ -390,11 +390,14 @@ def held_server(n_holders: int = 1, **server_kwargs):
         threading.Thread(target=lambda: results.append(get(base + "/metrics")[0]))
         for _ in range(n_holders)
     ]
-    for t in holders:
-        t.start()
-    for _ in holders:
-        assert entered.acquire(timeout=5)  # each holder is INSIDE the render
     try:
+        # Inside the try: a timed-out acquire on a loaded host must still
+        # release the semaphores and stop the server, or the blocked holder
+        # threads hang pytest at interpreter exit.
+        for t in holders:
+            t.start()
+        for _ in holders:
+            assert entered.acquire(timeout=5)  # holder is INSIDE the render
         yield HeldServer(server, base, release, holders, results)
     finally:
         release.release(64)

@@ -212,6 +212,7 @@ class ExporterApp:
             # Deferred attribute read: self.server is constructed below;
             # the first poll (in start()) runs after __init__ completes.
             scrape_rejects_fn=lambda: dict(self.server.scrape_rejects),
+            loop_overruns_fn=lambda: self.loop.overruns,
             scrape_duration_hist=scrape_hist,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)

@@ -78,6 +78,7 @@ class Collector:
         legacy_metrics: bool = False,
         process_scanner=None,
         scrape_rejects_fn=None,  # () -> {cause: int}, from the HTTP guard
+        loop_overruns_fn=None,   # () -> int, from the CollectorLoop
         scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
         clock=time.monotonic,
         wallclock=time.time,
@@ -86,6 +87,7 @@ class Collector:
         self._attribution = attribution
         self._process_scanner = process_scanner
         self._scrape_rejects_fn = scrape_rejects_fn
+        self._loop_overruns_fn = loop_overruns_fn
         self._store = store
         self._topology = topology or HostTopology()
         self._resource_name = resource_name
@@ -573,6 +575,14 @@ class Collector:
                         float(n),
                         (cause,),
                     )
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
+        if self._loop_overruns_fn is not None:
+            try:
+                b.add(
+                    schema.TPU_EXPORTER_POLL_OVERRUNS_TOTAL,
+                    float(self._loop_overruns_fn()),
+                )
             except Exception:  # noqa: BLE001 — accounting must never fail a poll
                 pass
 

@@ -254,6 +254,12 @@ TPU_EXPORTER_POLLS_TOTAL = MetricSpec(
     type=COUNTER,
 )
 
+TPU_EXPORTER_POLL_OVERRUNS_TOTAL = MetricSpec(
+    name="tpu_exporter_poll_overruns_total",
+    help="Poll ticks skipped because the previous iteration overran the interval — rising means the interval is too tight for this host/backend.",
+    type=COUNTER,
+)
+
 TPU_EXPORTER_SERIES = MetricSpec(
     name="tpu_exporter_series",
     help="Number of time series in the current snapshot.",
@@ -336,6 +342,7 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_POLL_DURATION_SECONDS,
     TPU_EXPORTER_POLL_ERRORS_TOTAL,
     TPU_EXPORTER_POLLS_TOTAL,
+    TPU_EXPORTER_POLL_OVERRUNS_TOTAL,
     TPU_EXPORTER_SERIES,
     TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS,
     TPU_EXPORTER_CPU_SECONDS_TOTAL,
