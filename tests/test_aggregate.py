@@ -631,6 +631,22 @@ class TestAggregateHonesty:
         )
 
 
+class TestAggregatorDebugVars:
+    def test_layout_sizes_and_targets(self):
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000", "down:8000"), store,
+            fetch=StaticFetch(pages, down={"down:8000"}),
+        )
+        agg.poll_once()
+        agg.close()
+        dv = agg.debug_vars()
+        assert dv["targets"] == ["h0:8000", "down:8000"]
+        assert dv["layout_entries"]["h0:8000"] > 100  # parsed a real body
+        assert dv["layout_entries"]["down:8000"] == 0  # never reachable
+
+
 class TestAggregatorHistograms:
     def test_round_and_scrape_histograms_exposed_and_om_valid(self):
         from prometheus_client.openmetrics.parser import (

@@ -466,6 +466,21 @@ class SliceAggregator:
             agg = slices[key] = _SliceAgg()
         return agg
 
+    def debug_vars(self) -> dict:
+        """Introspection payload for /debug/vars — the aggregator twin of
+        ExporterApp._debug_vars. Reads are cross-thread but safe: layout
+        lists are swapped atomically by the publish thread."""
+        return {
+            "targets": list(self._targets),
+            "timeout_s": self._timeout_s,
+            # Per-target parsed-layout sizes: 0 = never parsed (target has
+            # been down since start); steady state ≈ body line count.
+            "layout_entries": {
+                t: len(layout.entries)
+                for t, layout in self._parse_layouts.items()
+            },
+        }
+
     def close(self) -> None:
         self._pool.shutdown(wait=False)
 
@@ -498,6 +513,7 @@ def main(argv: list[str] | None = None) -> int:
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         max_scrapes_per_s=ns.max_scrapes_per_s,
+        debug_vars=agg.debug_vars,
     )
 
     stop = threading.Event()
