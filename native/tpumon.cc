@@ -350,7 +350,103 @@ long tpumon_scan_proc(const char* proc_root, const char* prefixes,
   return count;
 }
 
+// Whole-body value-only parse against a cached layout — the inverse of
+// tpumon_render2, for the aggregator's steady state (the parse-side twin
+// of the exporter's render layout cache). One entry per line of the
+// previous round's body:
+//   kinds[i] == 0: verbatim line (comment/blank) — the raw line must
+//                  byte-equal keys[i].
+//   kinds[i] == 1: name-filtered sample — the line must start with
+//                  keys[i] followed by a space/tab; the rest is ignored.
+//   kinds[i] == 2: consumed sample — prefix like kind 1, then the first
+//                  whitespace token of the tail must parse fully as a
+//                  float (written to out_values in kind-2 order); any
+//                  trailing timestamp/garbage is ignored EXCEPT braces,
+//                  which change the line's brace grammar entirely.
+//
+// Returns the number of kind-2 values written on a PERFECT whole-body
+// match (every line consumed by its entry, every entry consumed), else
+// -1 — the caller falls back to the Python parser, which owns all
+// divergence/rebuild semantics. Deliberately conservative: anything the
+// Python hit path would not accept byte-for-byte (leading whitespace,
+// braces in tails, hex floats strtod would take but Python float()
+// rejects, oversized value tokens) returns -1 rather than guessing.
+long tpumon_parse_layout(const char* text, long n_text, const char** keys,
+                         const int* klens, const unsigned char* kinds,
+                         long n_entries, double* out_values) {
+  if (text == nullptr || keys == nullptr || klens == nullptr ||
+      kinds == nullptr || out_values == nullptr || n_text < 0)
+    return -1;
+  long i = 0;       // entry cursor
+  long nvals = 0;   // kind-2 values written
+  const char* p = text;
+  const char* end = text + n_text;
+  // Python's text.split("\n") yields a segment after the final newline
+  // too (possibly empty) — mirror that exactly.
+  for (;;) {
+    const char* nl = (const char*)std::memchr(p, '\n', (size_t)(end - p));
+    const char* line = p;
+    long llen = (nl != nullptr ? nl : end) - p;
+    if (i >= n_entries) return -1;  // body grew
+    const char* key = keys[i];
+    long klen = klens[i];
+    unsigned char kind = kinds[i];
+    ++i;
+    if (kind == 0) {
+      if (llen != klen || std::memcmp(line, key, (size_t)llen) != 0)
+        return -1;
+    } else {
+      if (llen <= klen || std::memcmp(line, key, (size_t)klen) != 0)
+        return -1;
+      char b = line[klen];
+      if (b != ' ' && b != '\t') return -1;
+      if (kind == 2) {
+        // Tail: optional ASCII whitespace, one value token, then
+        // anything brace-free (the Python hit path drops timestamps the
+        // same way). NULs can't slip through: the token is copied into a
+        // bounded NUL-terminated buffer and must be consumed entirely.
+        const char* t = line + klen + 1;
+        const char* tend = line + llen;
+        while (t < tend && (*t == ' ' || *t == '\t' || *t == '\r' ||
+                            *t == '\v' || *t == '\f'))
+          ++t;
+        const char* tok = t;
+        while (t < tend && *t != ' ' && *t != '\t' && *t != '\r' &&
+               *t != '\v' && *t != '\f')
+          ++t;
+        long toklen = t - tok;
+        if (toklen <= 0 || toklen >= 64) return -1;
+        char val[64];
+        std::memcpy(val, tok, (size_t)toklen);
+        val[toklen] = '\0';
+        // strtod accepts tokens Python float() does not — reject every
+        // such shape so the native path never widens the grammar:
+        // hex floats ("0x1p3"), nan payloads ("nan(123)"), and — under a
+        // comma-decimal LC_NUMERIC in an embedding process — "1,5".
+        for (long k = 0; k < toklen; ++k) {
+          char c = val[k];
+          if (c == 'x' || c == 'X' || c == '(' || c == ')' || c == ',')
+            return -1;
+        }
+        char* endptr = nullptr;
+        double v = std::strtod(val, &endptr);
+        if (endptr != val + toklen) return -1;
+        // The rest of the tail is ignored like Python's split()[0] — but
+        // braces would change the reference brace grammar: reject.
+        if (std::memchr(t, '{', (size_t)(tend - t)) != nullptr ||
+            std::memchr(t, '}', (size_t)(tend - t)) != nullptr)
+          return -1;
+        out_values[nvals++] = v;
+      }
+    }
+    if (nl == nullptr) break;
+    p = nl + 1;
+  }
+  if (i != n_entries) return -1;  // body shrank
+  return nvals;
+}
+
 // ABI version for the ctypes loader to sanity-check.
-int tpumon_abi_version(void) { return 3; }
+int tpumon_abi_version(void) { return 4; }
 
 }  // extern "C"

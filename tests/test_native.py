@@ -80,3 +80,74 @@ class TestNativeRender:
         fams = {f.name: f for f in text_string_to_metric_families(text)}
         assert len(fams["m"].samples) == 100
         assert fams["m"].samples[3].value == 4.5
+
+
+class TestNativeParseLayout:
+    """The whole-body native parse must be a strict subset of the Python
+    layout parser: identical values on perfect matches, None on anything
+    else (incl. shapes where native acceptance would widen the grammar)."""
+
+    NAMES = frozenset({"m", "tpu_x"})
+
+    def _warm(self, text):
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache()
+        parse_exposition_layout(text, self.NAMES, layout)
+        return layout
+
+    def test_values_match_python(self, built_lib):
+        t1 = (
+            "# HELP m h\n# TYPE m gauge\n"
+            'm{a="1"} 5\nskip{a="1"} 2\nm{a="2"} NaN\n'
+            "tpu_x +Inf\nm 2.5 1700000000\n"
+        )
+        layout = self._warm(t1)
+        t2 = t1.replace(" 5\n", " 50\n").replace(" 2.5 ", " -7.25 ")
+        got = native.parse_layout(layout, t2)
+        assert got is not None
+        import math
+
+        assert got[0] == 50.0
+        assert math.isnan(got[1])
+        assert got[2] == math.inf
+        assert got[3] == -7.25
+
+    def test_rejects_what_python_float_rejects(self, built_lib):
+        # strtod would take a hex float; Python float() raises — native
+        # must decline so the Python parser can raise ParseError.
+        layout = self._warm("m 5\n")
+        assert native.parse_layout(layout, "m 0x1p3\n") is None
+
+    def test_rejects_brace_tails(self, built_lib):
+        layout = self._warm('m{a="1"} 5\nm{a="2"} 6\n')
+        assert native.parse_layout(layout, 'm{a="1"} 5 m{a="2"} 6\n') is None
+
+    def test_rejects_shape_changes(self, built_lib):
+        layout = self._warm("m 1\nm 2\n")
+        assert native.parse_layout(layout, "m 1\n") is None          # shrank
+        assert native.parse_layout(layout, "m 1\nm 2\nm 3\n") is None  # grew
+        assert native.parse_layout(layout, "m2 1\nm 2\n") is None    # renamed
+
+    def test_arrays_rebuilt_on_churn(self, built_lib):
+        from tpu_pod_exporter.metrics.parse import parse_exposition_layout
+
+        layout = self._warm("m 1\n")
+        built = layout.native_built_for
+        parse_exposition_layout("m 1\nm 2\n", self.NAMES, layout)  # churn
+        got = native.parse_layout(layout, "m 3\nm 4\n")
+        assert got == [3.0, 4.0]
+        assert layout.native_built_for is not built
+
+    def test_end_to_end_fast_path_returns_shared_labels(self, built_lib):
+        from tpu_pod_exporter.metrics.parse import parse_exposition_layout
+
+        t = 'm{a="1"} 5\n'
+        layout = self._warm(t)
+        r1 = parse_exposition_layout(t, self.NAMES, layout)
+        r2 = parse_exposition_layout('m{a="1"} 6\n', self.NAMES, layout)
+        assert r1[0][1] is r2[0][1]  # labels dict shared via the template
+        assert r2[0][2] == 6.0

@@ -8,7 +8,7 @@ corruption-prone invariants:
 
 import math
 
-from hypothesis import given, settings, strategies as st
+from hypothesis import HealthCheck, given, settings, strategies as st
 from prometheus_client.parser import text_string_to_metric_families
 
 from tpu_pod_exporter.metrics.registry import (
@@ -259,7 +259,24 @@ class TestLayoutParserDifferential:
     """parse_exposition_layout must agree with parse_exposition on EVERY
     body — including corrupted ones — through any warm/cold cache state
     (code-review r5: the hit path once accepted brace-corrupted lines the
-    reference parser rejects)."""
+    reference parser rejects; the NATIVE whole-body path once accepted
+    strtod's nan(123) payloads Python float() rejects). Parametrized over
+    both parse paths so native coverage never depends on test order."""
+
+    import pytest as _pytest
+
+    @_pytest.fixture(params=["native", "pure"], autouse=True)
+    def _parse_path(self, request, monkeypatch):
+        if request.param == "pure":
+            monkeypatch.setattr(
+                "tpu_pod_exporter.metrics.parse._native_parse_layout",
+                lambda layout, text: None,
+            )
+        else:
+            from tpu_pod_exporter import nativelib
+
+            if nativelib.load() is None:
+                self._pytest.skip("native lib unavailable")
 
     _names = st.sampled_from(["m", "tpu_x", "other", "sk"])
     _line = st.one_of(
@@ -306,12 +323,23 @@ class TestLayoutParserDifferential:
                 "m2 1",
                 'tpu_x 5 {oops} 1',
                 "m nope",
+                # strtod-wider-than-float() shapes the native path must
+                # decline (it did not always — code-review r5):
+                "m nan(123)",
+                "m 0x1p3",
+                "m 1_0",
+                "m 1,5",
+                "m Infinity",
+                "tpu_x -inf 1700000000",
             ]
         ),
     )
 
     @given(bodies=st.lists(st.lists(_line, max_size=12), min_size=1, max_size=4))
-    @settings(max_examples=150, deadline=None)
+    @settings(
+        max_examples=150, deadline=None,
+        suppress_health_check=[HealthCheck.function_scoped_fixture],
+    )
     def test_layout_parser_matches_reference_through_any_cache_state(
         self, bodies
     ):

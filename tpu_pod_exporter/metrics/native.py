@@ -58,6 +58,42 @@ def render_layout(layout, values) -> bytes | None:
     return ctypes.string_at(buf, written)
 
 
+def parse_layout(layout, text: str) -> "list[float] | None":
+    """Whole-body value-only parse of one exposition body against a warm
+    :class:`~tpu_pod_exporter.metrics.parse.LayoutCache` — the parse-side
+    inverse of :func:`render_layout`. Returns the kind-2 entry values in
+    entry order on a PERFECT byte-level match of every line, else None
+    (the Python parser owns all divergence/rebuild semantics). The ctypes
+    key arrays are cached on the layout and rebuilt only when its entries
+    list is swapped (churn)."""
+    lib = nativelib.load()
+    entries = layout.entries
+    if lib is None or not entries:
+        return None
+    if layout.native_built_for is not entries:
+        keys = [ent[1].encode() for ent in entries]
+        n = len(entries)
+        # The c_char_p array holds pointers INTO the bytes objects; keep
+        # the list alive alongside it.
+        layout.native_keybytes = keys
+        layout.native_keys = (ctypes.c_char_p * n)(*keys)
+        layout.native_klens = (ctypes.c_int * n)(*map(len, keys))
+        layout.native_kinds = (ctypes.c_ubyte * n)(*(e[0] for e in entries))
+        layout.samples_template = [
+            (e[2], e[3]) for e in entries if e[0] == 2
+        ]
+        layout.native_out = (ctypes.c_double * len(layout.samples_template))()
+        layout.native_built_for = entries
+    data = text.encode()
+    got = lib.tpumon_parse_layout(
+        data, len(data), layout.native_keys, layout.native_klens,
+        layout.native_kinds, len(entries), layout.native_out,
+    )
+    if got != len(layout.native_out):
+        return None
+    return list(layout.native_out)
+
+
 def load():
     """Kept for tests: the shared library handle (or None)."""
     return nativelib.load()

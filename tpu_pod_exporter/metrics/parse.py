@@ -228,12 +228,36 @@ class LayoutCache:
       ``(0, line)``                 verbatim line (comment/blank) — skip
       ``(1, prefix)``               name-filtered sample line — skip
       ``(2, prefix, name, labels)`` consumed sample — labels dict SHARED
+
+    The ``native_*`` slots cache the ctypes views libtpumon's whole-body
+    fast path needs (see ``metrics/native.py::parse_layout``); they are
+    rebuilt whenever ``entries`` is swapped (``native_built_for`` tracks
+    the list identity) and the ``samples_template`` gives the (name,
+    labels) pair for each kind-2 entry in order.
     """
 
-    __slots__ = ("entries",)
+    __slots__ = (
+        "entries", "native_built_for", "native_keybytes", "native_keys",
+        "native_klens", "native_kinds", "native_out", "samples_template",
+    )
 
     def __init__(self) -> None:
         self.entries: list[tuple] = []
+        self.native_built_for = None
+        self.native_keybytes = None
+        self.native_keys = None
+        self.native_klens = None
+        self.native_kinds = None
+        self.native_out = None
+        self.samples_template: list[tuple] | None = None
+
+
+def _native_parse_layout(layout, text):
+    try:
+        from tpu_pod_exporter.metrics import native
+    except ImportError:  # partial deployment: the parser must not die
+        return None
+    return native.parse_layout(layout, text)
 
 
 def parse_exposition_layout(
@@ -251,6 +275,19 @@ def parse_exposition_layout(
     the rebuilt layout serves the next round. On ParseError the cache is
     left untouched (the next round re-parses)."""
     entries = layout.entries
+    if entries:
+        # Whole-body native fast path: on a perfect byte-level match of
+        # every line (values aside), C returns just the values and the
+        # cached (name, labels) template supplies the rest — no per-line
+        # Python at all. Any divergence returns None and this function's
+        # own per-line hit path (below) takes over.
+        values = _native_parse_layout(layout, text)
+        if values is not None:
+            tmpl = layout.samples_template
+            return [
+                (name, labels, v)
+                for (name, labels), v in zip(tmpl, values)
+            ]
     n_cached = len(entries)
     # Lazily materialized: a fully-aligned round (the steady state) never
     # builds a new list at all — entries[:kept] stays the layout.

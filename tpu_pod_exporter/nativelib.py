@@ -17,7 +17,7 @@ from pathlib import Path
 
 log = logging.getLogger("tpu_pod_exporter.nativelib")
 
-ABI_VERSION = 3
+ABI_VERSION = 4
 
 _lock = threading.Lock()
 _lib: ctypes.CDLL | None = None
@@ -79,6 +79,16 @@ def load() -> ctypes.CDLL | None:
                     ctypes.c_char_p,
                     ctypes.c_char_p,
                     ctypes.c_long,
+                ]
+                lib.tpumon_parse_layout.restype = ctypes.c_long
+                lib.tpumon_parse_layout.argtypes = [
+                    ctypes.c_char_p,
+                    ctypes.c_long,
+                    ctypes.POINTER(ctypes.c_char_p),
+                    ctypes.POINTER(ctypes.c_int),
+                    ctypes.POINTER(ctypes.c_ubyte),
+                    ctypes.c_long,
+                    ctypes.POINTER(ctypes.c_double),
                 ]
                 _lib = lib
                 log.info("libtpumon loaded from %s", cand)
