@@ -20,6 +20,17 @@ from pathlib import Path
 sys.path.insert(0, str(Path(__file__).resolve().parent))
 
 
+def _rss_bytes() -> int:
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS:"):
+                    return int(line.split()[1]) * 1024
+    except OSError:  # no procfs (non-Linux): report 0, keep the timings
+        pass
+    return 0
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--hosts", type=int, default=64)
@@ -62,6 +73,10 @@ def main(argv=None) -> int:
         "rounds": args.rounds,
         "min_ms": round(min(times) * 1000, 1),
         "max_ms": round(max(times) * 1000, 1),
+        # Steady-state footprint incl. the per-target layout caches
+        # (≈ one parsed body's strings per target — the cost of the
+        # value-only re-parse path; BASELINE.md documents the trade).
+        "rss_mb": round(_rss_bytes() / 1e6, 1),
     }))
     return 0
 

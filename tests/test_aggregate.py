@@ -954,6 +954,43 @@ class TestLayoutParser:
         t = 'm{a="q\\"uote",b="back\\\\slash\\n"} 5\n'
         self._both([t, t])
 
+    def test_oversized_body_never_cached_but_parses_correctly(self, caplog):
+        import logging
+
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache(max_entries=3)
+        text = "m 1\nm 2\nm 3\nm 4\n"  # 5 entries incl. trailing blank
+        with caplog.at_level(logging.WARNING, "tpu_pod_exporter.metrics.parse"):
+            r1 = parse_exposition_layout(text, self.NAMES, layout)
+            r2 = parse_exposition_layout(text, self.NAMES, layout)
+        assert r1 == r2 == [("m", {}, float(i)) for i in (1, 2, 3, 4)]
+        assert layout.entries == []  # never cached
+        assert sum("layout cache cap" in r.message for r in caplog.records) == 1
+
+    def test_oversize_transition_drops_native_buffers(self):
+        # A target whose body GROWS past the cap must release the native
+        # ctypes buffers built while it was small — they hold a body's
+        # worth of encoded prefixes (code-review r5).
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache(max_entries=4)
+        parse_exposition_layout("m 1\nm 2\n", self.NAMES, layout)
+        # Simulate the native arrays having been built for the small body.
+        layout.native_built_for = layout.entries
+        layout.native_keybytes = [b"m"]
+        big = "m 1\nm 2\nm 3\nm 4\nm 5\n"
+        parse_exposition_layout(big, self.NAMES, layout)
+        assert layout.entries == []
+        assert layout.native_built_for is None
+        assert layout.native_keybytes is None
+
     def test_brace_corrupted_tail_on_warm_prefix_still_raises(self):
         # Code-review r5 repro: two lines joined by a lost newline. The
         # reference parser's rfind('}') picks the LATER brace and raises

@@ -70,7 +70,7 @@ def parse_layout(layout, text: str) -> "list[float] | None":
     entries = layout.entries
     if lib is None or not entries:
         return None
-    if layout.native_built_for is not entries:
+    if layout.native_built_for is not entries or layout.native_out is None:
         keys = [ent[1].encode() for ent in entries]
         n = len(entries)
         # The c_char_p array holds pointers INTO the bytes objects; keep

@@ -10,9 +10,12 @@ break when a newer exporter adds metrics.
 
 from __future__ import annotations
 
+import logging
 import re
 import threading
 from typing import Iterator, NamedTuple
+
+log = logging.getLogger("tpu_pod_exporter.metrics.parse")
 
 
 class ParsedSample(NamedTuple):
@@ -237,12 +240,21 @@ class LayoutCache:
     """
 
     __slots__ = (
-        "entries", "native_built_for", "native_keybytes", "native_keys",
-        "native_klens", "native_kinds", "native_out", "samples_template",
+        "entries", "max_entries", "oversize_logged", "native_built_for",
+        "native_keybytes", "native_keys", "native_klens", "native_kinds",
+        "native_out", "samples_template",
     )
 
-    def __init__(self) -> None:
+    def __init__(self, max_entries: int = 32768) -> None:
         self.entries: list[tuple] = []
+        # Memory ceiling: a cached layout holds roughly the body's strings
+        # plus per-line tuples (~60 KB per 1k lines measured), so an
+        # unbounded cache lets one pathological target grow a sidecar
+        # without limit. Bodies beyond the cap simply parse the slow path
+        # every round (correct, just uncached). 32k lines ≈ 7× a
+        # 256-chip exporter body.
+        self.max_entries = max_entries
+        self.oversize_logged = False
         self.native_built_for = None
         self.native_keybytes = None
         self.native_keys = None
@@ -355,7 +367,29 @@ def parse_exposition_layout(
         else:
             new_entries.append(ent)
     if new_entries is not None:
-        layout.entries = new_entries
+        if len(new_entries) <= layout.max_entries:
+            layout.entries = new_entries
+        else:
+            # Over the memory ceiling: never cache, re-parse every round.
+            if not layout.oversize_logged:
+                layout.oversize_logged = True
+                log.warning(
+                    "exposition body has %d lines (> layout cache cap %d); "
+                    "parsing uncached every round for this target",
+                    len(new_entries), layout.max_entries,
+                )
+            if layout.entries:
+                layout.entries = []
+            # Drop the native ctypes buffers/template too — they hold a
+            # body's worth of encoded prefixes, exactly what the cap
+            # exists to bound (code-review r5).
+            layout.native_built_for = None
+            layout.native_keybytes = None
+            layout.native_keys = None
+            layout.native_klens = None
+            layout.native_kinds = None
+            layout.native_out = None
+            layout.samples_template = None
     elif kept != n_cached:
         layout.entries = entries[:kept]  # body shrank, still aligned
     return out
