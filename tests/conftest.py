@@ -14,12 +14,43 @@ skips). ``pin_cpu_inprocess`` re-updates the already-imported jax config
 in-process — the numeric suites then run everywhere, hardware or not.
 """
 
+import subprocess
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
 
 import pytest  # noqa: E402
+
+
+def _ensure_native_built() -> None:
+    """Best-effort build of native/libtpumon.so before tests run.
+
+    A fresh checkout has no compiled artifact; without it every native-path
+    test silently exercises only the pure-Python fallback and the aggregator
+    scale guards measure the slow parser. One ~2 s g++ invocation at session
+    start keeps the tested configuration equal to the deployed one. Failures
+    are non-fatal — the fallbacks are themselves under test.
+    """
+    native = Path(__file__).resolve().parent.parent / "native"
+    so = native / "libtpumon.so"
+    src = native / "tpumon.cc"
+    try:
+        if not src.exists() or (
+            so.exists() and so.stat().st_mtime >= src.stat().st_mtime
+        ):
+            return
+        subprocess.run(
+            ["make", "-C", str(native)],
+            check=False,
+            capture_output=True,
+            timeout=60,
+        )
+    except Exception:
+        pass
+
+
+_ensure_native_built()
 
 from tpu_pod_exporter.attribution.fake import FakeAttribution, simple_allocation  # noqa: E402
 from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript  # noqa: E402

@@ -645,6 +645,24 @@ class TestAggregatorDebugVars:
         assert dv["targets"] == ["h0:8000", "down:8000"]
         assert dv["layout_entries"]["h0:8000"] > 100  # parsed a real body
         assert dv["layout_entries"]["down:8000"] == 0  # never reachable
+        assert dv["layout_oversize"] == {"h0:8000": False, "down:8000": False}
+
+    def test_oversize_target_distinguishable_from_down(self):
+        # layout_entries=0 is ambiguous (down vs deliberately uncached);
+        # layout_oversize disambiguates so an operator doesn't misdiagnose
+        # a healthy oversize target as down (code-review r5).
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000",), store, fetch=StaticFetch(pages),
+        )
+        for layout in agg._parse_layouts.values():
+            layout.max_entries = 10  # force the oversize path
+        agg.poll_once()
+        agg.close()
+        dv = agg.debug_vars()
+        assert dv["layout_entries"]["h0:8000"] == 0
+        assert dv["layout_oversize"]["h0:8000"] is True
 
 
 class TestAggregatorHistograms:
@@ -954,14 +972,24 @@ class TestLayoutParser:
         t = 'm{a="q\\"uote",b="back\\\\slash\\n"} 5\n'
         self._both([t, t])
 
-    def test_oversized_body_never_cached_but_parses_correctly(self, caplog):
+    def test_oversized_body_never_cached_but_parses_correctly(
+        self, caplog, monkeypatch
+    ):
         import logging
 
+        from tpu_pod_exporter.metrics import parse as parse_mod
         from tpu_pod_exporter.metrics.parse import (
             LayoutCache,
             parse_exposition_layout,
         )
+        from tpu_pod_exporter.utils import RateLimitedLogger
 
+        # Fresh unthrottled limiter: the module-global one may have been
+        # consumed by an earlier test in this session.
+        monkeypatch.setattr(
+            parse_mod, "_rlog",
+            RateLimitedLogger(parse_mod.log, min_interval_s=0.0),
+        )
         layout = LayoutCache(max_entries=3)
         text = "m 1\nm 2\nm 3\nm 4\n"  # 5 entries incl. trailing blank
         with caplog.at_level(logging.WARNING, "tpu_pod_exporter.metrics.parse"):
@@ -990,6 +1018,115 @@ class TestLayoutParser:
         assert layout.entries == []
         assert layout.native_built_for is None
         assert layout.native_keybytes is None
+
+    def test_oversize_flag_clears_on_shrink_back_and_rewarns(
+        self, caplog, monkeypatch
+    ):
+        # oversize_logged tracks the CURRENT condition: a body that shrinks
+        # back under the cap re-enters the cache and clears the flag, and a
+        # later genuine re-oversize warns again (code-review r5: a sticky
+        # flag misreported recovered targets as still slow).
+        import logging
+
+        from tpu_pod_exporter.metrics import parse as parse_mod
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+        from tpu_pod_exporter.utils import RateLimitedLogger
+
+        # Unthrottled limiter so both warnings emit deterministically.
+        monkeypatch.setattr(
+            parse_mod, "_rlog",
+            RateLimitedLogger(parse_mod.log, min_interval_s=0.0),
+        )
+        layout = LayoutCache(max_entries=4)
+        big = "m 1\nm 2\nm 3\nm 4\nm 5\n"
+        small = "m 1\nm 2\n"
+        with caplog.at_level(logging.WARNING, "tpu_pod_exporter.metrics.parse"):
+            parse_exposition_layout(big, self.NAMES, layout)
+            assert layout.oversize_logged
+            parse_exposition_layout(small, self.NAMES, layout)
+            assert not layout.oversize_logged
+            assert layout.entries  # re-cached
+            parse_exposition_layout(big, self.NAMES, layout)
+            assert layout.oversize_logged
+        assert sum("layout cache cap" in r.message for r in caplog.records) == 2
+
+    def test_oversize_flap_warnings_rate_limited(self, caplog, monkeypatch):
+        # A body flapping across the cap boundary every round must not warn
+        # every other round (~1800 lines/hour at 1 s polls — code-review
+        # r5): the module-global RateLimitedLogger admits one line per
+        # window across all targets.
+        import logging
+
+        from tpu_pod_exporter.metrics import parse as parse_mod
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            parse_exposition_layout,
+        )
+        from tpu_pod_exporter.utils import RateLimitedLogger
+
+        monkeypatch.setattr(
+            parse_mod, "_rlog",
+            RateLimitedLogger(parse_mod.log, min_interval_s=60.0, clock=lambda: 0.0),
+        )
+        layout = LayoutCache(max_entries=4)
+        big = "m 1\nm 2\nm 3\nm 4\nm 5\n"
+        small = "m 1\nm 2\n"
+        with caplog.at_level(logging.WARNING, "tpu_pod_exporter.metrics.parse"):
+            for _ in range(10):  # 10 full flap cycles
+                parse_exposition_layout(big, self.NAMES, layout)
+                parse_exposition_layout(small, self.NAMES, layout)
+        assert sum("layout cache cap" in r.message for r in caplog.records) == 1
+
+    def test_torn_undercap_scrape_does_not_clear_oversize_flag(self):
+        # A target in the oversize state returns one truncated under-cap
+        # body with a malformed line: the ParseError round must leave ALL
+        # cache state untouched — flag included — or debug_vars briefly
+        # reports layout_entries=0 with layout_oversize=False, the exact
+        # "looks down" misdiagnosis the flag prevents (code-review r5).
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache(max_entries=4)
+        parse_exposition_layout("m 1\nm 2\nm 3\nm 4\nm 5\n", self.NAMES, layout)
+        assert layout.oversize_logged and layout.entries == []
+        with pytest.raises(ParseError):
+            parse_exposition_layout("m 1\nm zzz\n", self.NAMES, layout)
+        assert layout.oversize_logged  # condition never actually cleared
+        assert layout.entries == []
+        # A clean under-cap round IS recovery: flag clears, body re-caches.
+        parse_exposition_layout("m 1\nm 2\n", self.NAMES, layout)
+        assert not layout.oversize_logged and layout.entries
+
+    def test_oversize_parse_error_leaves_warm_cache_intact(self):
+        # Contract: "On ParseError the cache is left untouched." A warm
+        # small-body layout followed by an oversize body with a malformed
+        # line must keep the warm layout so the target's recovery round
+        # gets the value-only hit path, not a cold parse (code-review r5).
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition_layout,
+        )
+
+        layout = LayoutCache(max_entries=4)
+        parse_exposition_layout("m 1\nm 2\n", self.NAMES, layout)
+        warm = layout.entries
+        assert warm
+        bad_big = "m 1\nm 2\nm 3\nm zzz\nm 5\n"
+        with pytest.raises(ParseError):
+            parse_exposition_layout(bad_big, self.NAMES, layout)
+        assert layout.entries is warm  # untouched
+        assert not layout.oversize_logged  # warning deferred to a good round
+        # Recovery with the original small body: still a cache hit.
+        r = parse_exposition_layout("m 7\nm 8\n", self.NAMES, layout)
+        assert r == [("m", {}, 7.0), ("m", {}, 8.0)]
+        assert layout.entries is warm
 
     def test_brace_corrupted_tail_on_warm_prefix_still_raises(self):
         # Code-review r5 repro: two lines joined by a lost newline. The

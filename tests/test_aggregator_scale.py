@@ -1,0 +1,60 @@
+"""Aggregator performance guard at slice-scale inputs (VERDICT r1 #8, r4 #6).
+
+Kept in its own module — away from test_multihost.py's live exporters —
+because module-scoped fixtures there keep 8 collector loops polling at 20 Hz
+until module teardown, and that background CPU load alone can triple the
+measured aggregator round on a busy CI machine.
+"""
+
+import time
+
+from tests.test_aggregate import StaticFetch, make_host_text
+
+from tpu_pod_exporter.aggregate import SliceAggregator
+from tpu_pod_exporter.metrics import SnapshotStore
+
+
+class TestAggregatorAtSliceScale:
+    """VERDICT r1 #8: aggregator perf at v5p-128-scale inputs — 64 targets,
+    ~16k total chip-series parsed per round (parse cost is O(total series)).
+    The assertion bound is deliberately loose (CI machines vary wildly);
+    the measured number is published in BASELINE.md by bench_aggregate.py."""
+
+    def test_round_duration_64_hosts(self):
+        body = make_host_text(0, chips=256)
+        pages = {}
+        for w in range(64):
+            # Re-label per host without re-running a 256-chip collector 64x.
+            pages[f"h{w}:8000"] = body.replace('host="host-0"', f'host="host-{w}"')
+        store = SnapshotStore()
+        agg = SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages))
+        try:
+            t0 = time.perf_counter()
+            agg.poll_once()
+            cold = time.perf_counter() - t0
+            snap = store.current()
+            key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+            assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
+            assert snap.value("tpu_slice_hosts_reporting", key) == 64.0
+            assert cold < 10.0, f"cold aggregator round took {cold:.2f}s at 64x256"
+            # Steady state: the per-target layout cache re-parses values only
+            # (~0.34 s measured — bench_aggregate.py / BASELINE.md); the
+            # round-5 guard locks that fast path in with headroom for slow
+            # CI machines. Best-of-3: this repo's CI can be a 1-core box
+            # where a single scheduler hiccup or GC pause doubles one
+            # measurement; the MINIMUM is the contention-free number the
+            # guard is actually about.
+            warm = min(self._timed_round(agg) for _ in range(3))
+            snap = store.current()
+            assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
+            assert warm < 3.0, f"warm aggregator round took {warm:.2f}s at 64x256"
+        finally:
+            # Release the 16-thread scrape pool: leaked idle threads are
+            # background noise for every later timing test in the session.
+            agg.close()
+
+    @staticmethod
+    def _timed_round(agg) -> float:
+        t0 = time.perf_counter()
+        agg.poll_once()
+        return time.perf_counter() - t0

@@ -141,41 +141,7 @@ class TestSliceAggregation:
         assert workers == {str(w) for w in range(NUM_HOSTS)}
 
 
-class TestAggregatorAtSliceScale:
-    """VERDICT r1 #8: aggregator perf at v5p-128-scale inputs — 64 targets,
-    ~16k total chip-series parsed per round (parse cost is O(total series)).
-    The assertion bound is deliberately loose (CI machines vary wildly);
-    the measured number is published in BASELINE.md by bench_aggregate.py."""
-
-    def test_round_duration_64_hosts(self):
-        import time
-
-        from tests.test_aggregate import StaticFetch, make_host_text
-
-        from tpu_pod_exporter.aggregate import SliceAggregator
-        from tpu_pod_exporter.metrics import SnapshotStore
-
-        body = make_host_text(0, chips=256)
-        pages = {}
-        for w in range(64):
-            # Re-label per host without re-running a 256-chip collector 64x.
-            pages[f"h{w}:8000"] = body.replace('host="host-0"', f'host="host-{w}"')
-        store = SnapshotStore()
-        agg = SliceAggregator(tuple(pages), store, fetch=StaticFetch(pages))
-        t0 = time.perf_counter()
-        agg.poll_once()
-        cold = time.perf_counter() - t0
-        snap = store.current()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
-        assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
-        assert snap.value("tpu_slice_hosts_reporting", key) == 64.0
-        assert cold < 10.0, f"cold aggregator round took {cold:.2f}s at 64x256"
-        # Steady state: the per-target layout cache re-parses values only
-        # (~0.34 s measured — bench_aggregate.py / BASELINE.md); the round-5
-        # guard locks that fast path in with headroom for slow CI machines.
-        t0 = time.perf_counter()
-        agg.poll_once()
-        warm = time.perf_counter() - t0
-        snap = store.current()
-        assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
-        assert warm < 3.0, f"warm aggregator round took {warm:.2f}s at 64x256"
+# TestAggregatorAtSliceScale lives in test_aggregator_scale.py: its timing
+# guards must not share a module with the live slice_apps exporters above —
+# the module-scoped fixture keeps 8 collector loops polling at 20 Hz until
+# module teardown, and that contention alone can triple the measured round.

@@ -473,10 +473,20 @@ class SliceAggregator:
         return {
             "targets": list(self._targets),
             "timeout_s": self._timeout_s,
-            # Per-target parsed-layout sizes: 0 = never parsed (target has
-            # been down since start); steady state ≈ body line count.
+            # Per-target parsed-layout sizes: 0 = never parsed (target down
+            # since start) OR deliberately uncached (oversize body — see
+            # layout_oversize below); steady state ≈ body line count.
             "layout_entries": {
                 t: len(layout.entries)
+                for t, layout in self._parse_layouts.items()
+            },
+            # True while a target's body exceeds the layout-cache cap: it
+            # parses uncached every round (healthy, just slower); cleared
+            # when the body shrinks back under the cap. Without this an
+            # operator reading layout_entries=0 would misdiagnose an
+            # oversize target as down after the WARNING scrolled away.
+            "layout_oversize": {
+                t: layout.oversize_logged
                 for t, layout in self._parse_layouts.items()
             },
         }

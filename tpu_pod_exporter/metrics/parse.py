@@ -17,6 +17,27 @@ from typing import Iterator, NamedTuple
 
 log = logging.getLogger("tpu_pod_exporter.metrics.parse")
 
+# Oversize-body warnings are rate-limited globally (not once-per-layout):
+# a body flapping across the cache cap re-arms the per-layout flag every
+# other round, and at a 1 s poll interval an unthrottled warning is ~1800
+# lines/hour (code-review r5). One line per 60 s across all targets is
+# plenty — debug_vars' layout_oversize carries the per-target state.
+_rlog = None
+
+
+def _warn_oversize(n_lines: int, cap: int) -> None:
+    global _rlog
+    if _rlog is None:
+        from tpu_pod_exporter.utils import RateLimitedLogger
+
+        _rlog = RateLimitedLogger(log, min_interval_s=60.0)
+    _rlog.warning(
+        "layout-oversize",
+        "exposition body has %d lines (> layout cache cap %d); "
+        "parsing uncached every round for this target",
+        n_lines, cap,
+    )
+
 
 class ParsedSample(NamedTuple):
     name: str
@@ -255,13 +276,24 @@ class LayoutCache:
         # 256-chip exporter body.
         self.max_entries = max_entries
         self.oversize_logged = False
+        self.samples_template: list[tuple] | None = None
+        self.drop_native()
+
+    def drop_native(self) -> None:
+        """Release the native fast-path buffers + template.
+
+        The single place that knows the full ``native_*`` field list (the
+        builder in ``metrics/native.py::parse_layout`` is the other); an
+        oversize transition or any future invalidation site calls this so
+        a forgotten field can't silently retain a body's worth of encoded
+        prefixes."""
         self.native_built_for = None
         self.native_keybytes = None
         self.native_keys = None
         self.native_klens = None
         self.native_kinds = None
         self.native_out = None
-        self.samples_template: list[tuple] | None = None
+        self.samples_template = None
 
 
 def _native_parse_layout(layout, text):
@@ -286,6 +318,29 @@ def parse_exposition_layout(
     first round) falls back to the full parser for the rest of the body;
     the rebuilt layout serves the next round. On ParseError the cache is
     left untouched (the next round re-parses)."""
+    # Oversize pre-check: the rebuilt entry list would hold exactly one
+    # tuple per line, so the line count alone decides cacheability. Bodies
+    # over the cap parse a bare loop with NO layout maintenance — the old
+    # path built the full new_entries list every round only to throw it
+    # away at the cap check (code-review r5). A body that later shrinks
+    # under the cap re-enters the cache on its next round.
+    if text.count("\n") + 1 > layout.max_entries:
+        # Parse FIRST (delegating to parse_exposition keeps the line
+        # grammar in one place; ParsedSample is a tuple subclass, and a
+        # micro-optimized plain tuple matters least on this once-per-round
+        # fallback), touch the cache only on success — a ParseError here
+        # must leave the warm layout intact per this function's contract.
+        out = list(parse_exposition(text, names))
+        if not layout.oversize_logged:
+            layout.oversize_logged = True
+            _warn_oversize(text.count("\n") + 1, layout.max_entries)
+        if layout.entries:
+            # Transition small->oversize: drop the cached layout AND the
+            # native ctypes buffers/template — they hold a body's worth
+            # of encoded prefixes, exactly what the cap bounds.
+            layout.entries = []
+            layout.drop_native()
+        return out
     entries = layout.entries
     if entries:
         # Whole-body native fast path: on a perfect byte-level match of
@@ -367,31 +422,21 @@ def parse_exposition_layout(
         else:
             new_entries.append(ent)
     if new_entries is not None:
-        if len(new_entries) <= layout.max_entries:
-            layout.entries = new_entries
-        else:
-            # Over the memory ceiling: never cache, re-parse every round.
-            if not layout.oversize_logged:
-                layout.oversize_logged = True
-                log.warning(
-                    "exposition body has %d lines (> layout cache cap %d); "
-                    "parsing uncached every round for this target",
-                    len(new_entries), layout.max_entries,
-                )
-            if layout.entries:
-                layout.entries = []
-            # Drop the native ctypes buffers/template too — they hold a
-            # body's worth of encoded prefixes, exactly what the cap
-            # exists to bound (code-review r5).
-            layout.native_built_for = None
-            layout.native_keybytes = None
-            layout.native_keys = None
-            layout.native_klens = None
-            layout.native_kinds = None
-            layout.native_out = None
-            layout.samples_template = None
+        # The oversize pre-check above guarantees len(new_entries) — one
+        # tuple per line — is within layout.max_entries here.
+        layout.entries = new_entries
     elif kept != n_cached:
         layout.entries = entries[:kept]  # body shrank, still aligned
+    if layout.oversize_logged:
+        # Body shrank back under the cap AND this round parsed cleanly
+        # (a ParseError above must leave all cache state untouched, flag
+        # included): clear the state here, at the success point, so
+        # debug_vars' layout_oversize reports the CURRENT condition and a
+        # later genuine re-oversize warns again (code-review r5 — a
+        # sticky flag sent operators chasing a slow-path problem that no
+        # longer existed; an early clear misreported a torn under-cap
+        # scrape as recovery).
+        layout.oversize_logged = False
     return out
 
 
