@@ -238,14 +238,65 @@ class TestRealHardwareFixture:
         assert "tpu_hbm_used_percent{" not in text
 
     def test_fixture_covers_many_polls(self):
-        from tpu_pod_exporter.backend.recorded import RecordedBackend
+        _assert_full_capture(self.FIXTURE, min_lines=60)
 
-        lines = self.FIXTURE.read_text().count("\n")
-        assert lines >= 60  # a real multi-minute capture, not a stub
-        # And the replayer accepts every record, not just the first.
-        backend = RecordedBackend(str(self.FIXTURE), loop=False)
-        for _ in range(lines):
-            assert backend.sample().chips
+
+def _assert_full_capture(fixture: Path, min_lines: int) -> None:
+    """Shared guard for the committed real-trace fixtures: the file is a
+    real multi-minute capture (not a stub) and the replayer accepts every
+    record, not just the first."""
+    from tpu_pod_exporter.backend.recorded import RecordedBackend
+
+    lines = fixture.read_text().count("\n")
+    assert lines >= min_lines
+    backend = RecordedBackend(str(fixture), loop=False)
+    for _ in range(lines):
+        assert backend.sample().chips
+
+
+class TestRound5RealHardwareFixture:
+    """The round-5 capture (tests/fixtures/real-trace-r5.jsonl, 100 polls
+    during the 05:33Z tunnel window) is the first NATIVELY post-fix real
+    trace: jaxdev recorded ``hbm_used: null`` directly, so replaying it
+    raw — no normalization step — must drive the absent-beats-fake-zero
+    pipeline end to end. The round-4 class above keeps the historical
+    pre-fix encoding as evidence; this one proves today's encoding is what
+    real hardware actually produces."""
+
+    FIXTURE = (
+        Path(__file__).resolve().parent / "fixtures" / "real-trace-r5.jsonl"
+    )
+
+    def test_raw_replay_drives_absent_hbm_pipeline(self):
+        from tpu_pod_exporter.attribution.fake import FakeAttribution
+        from tpu_pod_exporter.backend.recorded import RecordedBackend
+        from tpu_pod_exporter.collector import Collector
+        from tpu_pod_exporter.metrics import SnapshotStore
+
+        backend = RecordedBackend(str(self.FIXTURE))
+        sample = backend.sample()
+        (chip,) = sample.chips
+        assert chip.info.device_kind == "TPU v5 lite"
+        assert chip.hbm_used_bytes is None  # recorded null, not 0.0
+        assert chip.hbm_total_bytes is None
+        assert any("memory_stats" in e for e in sample.partial_errors)
+
+        store = SnapshotStore()
+        c = Collector(backend, FakeAttribution(), store)
+        c.poll_once()
+        snap = store.current()
+        text = snap.encode().decode()
+        assert 'device_kind="TPU v5 lite"' in text
+        assert "tpu_chip_info{" in text            # presence survives
+        assert "tpu_hbm_used_bytes{" not in text   # absent, not fake-zero
+        assert "tpu_hbm_total_bytes{" not in text
+        assert "tpu_hbm_used_percent{" not in text
+        assert snap.value(
+            "tpu_exporter_poll_errors_total", {"source": "device_partial"}
+        ) == 1.0
+
+    def test_fixture_covers_many_polls(self):
+        _assert_full_capture(self.FIXTURE, min_lines=100)  # the full capture
 
 
 def test_structurally_wrong_value_reports_path_and_line(tmp_path):
