@@ -5,6 +5,7 @@ so the aggregator is tested against the exact bytes exporters serve.
 """
 
 import math
+import sys
 import urllib.request
 from pathlib import Path
 
@@ -647,6 +648,22 @@ class TestAggregatorDebugVars:
         assert dv["layout_entries"]["h0:8000"] > 100  # parsed a real body
         assert dv["layout_entries"]["down:8000"] == 0  # never reachable
         assert dv["layout_oversize"] == {"h0:8000": False, "down:8000": False}
+
+    def test_aggregator_publishes_own_cpu_and_rss(self):
+        # Same auditability contract as the exporter's self-metrics: the
+        # aggregator's slice-scale cost budget (BASELINE.md) must be
+        # checkable from its exposition alone.
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        agg = SliceAggregator(("h0:8000",), store, fetch=StaticFetch(pages))
+        agg.poll_once()
+        agg.close()
+        snap = store.current()
+        cpu = snap.value("tpu_aggregator_cpu_seconds_total", {})
+        rss = snap.value("tpu_aggregator_rss_bytes", {})
+        assert cpu is not None and cpu > 0  # this test itself burned CPU
+        if sys.platform == "linux":  # absent-off-Linux is the contract
+            assert rss is not None and rss > 10 * 1024 * 1024  # a real RSS
 
     def test_oversize_target_distinguishable_from_down(self):
         # layout_entries=0 is ambiguous (down vs deliberately uncached);

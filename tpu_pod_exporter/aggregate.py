@@ -59,6 +59,7 @@ CONSUMED_NAMES = frozenset({
     "tpu_pod_hbm_used_bytes",
 })
 from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter import utils
 from tpu_pod_exporter.utils import RateLimitedLogger
 
 log = logging.getLogger("tpu_pod_exporter.aggregate")
@@ -353,6 +354,14 @@ class SliceAggregator:
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
+        # Self-resource accounting, same contract as the exporter's series:
+        # absent beats fake-zero when the platform can't report a value.
+        cpu_s = utils.process_cpu_seconds()
+        if cpu_s is not None:
+            b.add(schema.TPU_AGG_CPU_SECONDS_TOTAL, cpu_s)
+        rss = utils.process_rss_bytes()
+        if rss is not None:
+            b.add(schema.TPU_AGG_RSS_BYTES, rss)
         self._round_hist.emit(b)
         self._scrape_hist.emit(b)
         if round_started is not None:

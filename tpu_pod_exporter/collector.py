@@ -43,6 +43,7 @@ from tpu_pod_exporter.metrics import (
     SnapshotBuilder,
     SnapshotStore,
 )
+from tpu_pod_exporter import utils
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.registry import PrefixCache
 from tpu_pod_exporter.topology import HostTopology
@@ -557,14 +558,10 @@ class Collector:
         b.add(schema.TPU_EXPORTER_LAST_POLL_TIMESTAMP_SECONDS, self._wallclock())
 
         # Self-resource accounting (<1% CPU budget, auditable in production).
-        try:
-            import resource
-
-            ru = resource.getrusage(resource.RUSAGE_SELF)
-            b.add(schema.TPU_EXPORTER_CPU_SECONDS_TOTAL, ru.ru_utime + ru.ru_stime)
-        except Exception:  # noqa: BLE001 — accounting must never fail a poll
-            pass
-        rss = self._read_rss_bytes()
+        cpu_s = utils.process_cpu_seconds()
+        if cpu_s is not None:
+            b.add(schema.TPU_EXPORTER_CPU_SECONDS_TOTAL, cpu_s)
+        rss = utils.process_rss_bytes()
         if rss is not None:
             b.add(schema.TPU_EXPORTER_RSS_BYTES, rss)
         if self._scrape_rejects_fn is not None:
@@ -744,22 +741,6 @@ class Collector:
                     dcn_bw_s[lv] = round(bw) if bw > 0.0 else 0.0
                 rec[2] = folded
                 rec[3] = seq
-
-    _PAGE_SIZE = None
-
-    @classmethod
-    def _read_rss_bytes(cls) -> float | None:
-        """Current RSS from /proc/self/statm (field 2, pages); None off-Linux."""
-        try:
-            with open("/proc/self/statm") as f:
-                pages = int(f.read().split()[1])
-            if cls._PAGE_SIZE is None:
-                import os
-
-                cls._PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
-            return float(pages * cls._PAGE_SIZE)
-        except Exception:  # noqa: BLE001
-            return None
 
     def close(self) -> None:
         self._backend.close()

@@ -529,6 +529,22 @@ TPU_AGG_ROUND_DURATION_SECONDS = MetricSpec(
     type=GAUGE,
 )
 
+# Same self-resource accounting contract as the exporter's
+# tpu_exporter_cpu_seconds_total / _rss_bytes: the aggregator's own cost
+# at slice scale (BASELINE.md 64x256 budget) must be auditable from its
+# exposition alone.
+TPU_AGG_CPU_SECONDS_TOTAL = MetricSpec(
+    name="tpu_aggregator_cpu_seconds_total",
+    help="Total user+system CPU time consumed by the aggregator process.",
+    type=COUNTER,
+)
+
+TPU_AGG_RSS_BYTES = MetricSpec(
+    name="tpu_aggregator_rss_bytes",
+    help="Resident set size of the aggregator process (absent when /proc/self/statm is unreadable).",
+    type=GAUGE,
+)
+
 # Distribution companions (same rationale as the exporter's histograms:
 # a p99 must be computable from the exposition alone). Distinct base names
 # from the point-in-time gauges above — one exposition name, one type.
@@ -568,6 +584,8 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_SCRAPE_ERRORS_TOTAL,
     TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS,
     TPU_AGG_ROUND_DURATION_SECONDS,
+    TPU_AGG_CPU_SECONDS_TOTAL,
+    TPU_AGG_RSS_BYTES,
 )
 
 

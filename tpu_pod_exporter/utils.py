@@ -52,3 +52,39 @@ class RateLimitedLogger:
 
     def info(self, key: str, msg: str, *args, **kwargs) -> None:
         self._emit(logging.INFO, key, msg, *args, **kwargs)
+
+
+# --- Process self-resource accounting --------------------------------------
+# Shared by the exporter collector and the slice aggregator: both publish
+# their own CPU seconds and RSS so the <1% CPU / bounded-memory budgets
+# (BASELINE.md) are auditable in production, not just in bench.py. Both
+# functions are exception-safe (None on failure) — accounting must never
+# fail a poll or an aggregation round.
+
+_PAGE_SIZE: int | None = None
+
+
+def process_cpu_seconds() -> float | None:
+    """Total user+system CPU time of this process, or None off-POSIX."""
+    try:
+        import resource
+
+        ru = resource.getrusage(resource.RUSAGE_SELF)
+        return ru.ru_utime + ru.ru_stime
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def process_rss_bytes() -> float | None:
+    """Current RSS from /proc/self/statm (field 2, pages); None off-Linux."""
+    global _PAGE_SIZE
+    try:
+        with open("/proc/self/statm") as f:
+            pages = int(f.read().split()[1])
+        if _PAGE_SIZE is None:
+            import os
+
+            _PAGE_SIZE = os.sysconf("SC_PAGE_SIZE")
+        return float(pages * _PAGE_SIZE)
+    except Exception:  # noqa: BLE001
+        return None
