@@ -661,9 +661,45 @@ class TestAggregatorDebugVars:
         snap = store.current()
         cpu = snap.value("tpu_aggregator_cpu_seconds_total", {})
         rss = snap.value("tpu_aggregator_rss_bytes", {})
-        assert cpu is not None and cpu > 0  # this test itself burned CPU
-        if sys.platform == "linux":  # absent-off-Linux is the contract
+        if sys.platform == "linux":  # absent-off-POSIX/Linux is the contract
+            assert cpu is not None and cpu > 0  # this test itself burned CPU
             assert rss is not None and rss > 10 * 1024 * 1024  # a real RSS
+
+    def test_rollups_exact_while_target_crosses_cap(self):
+        # Integration churn for the oversize state machine: one target's
+        # body grows past the layout-cache cap mid-run (chip hotplug /
+        # label explosion) then shrinks back. Every round's rollups must
+        # be exact — the cached, uncached, and re-cached parse paths all
+        # feed the same fold — and debug vars must track the transitions.
+        small = make_host_text(0, chips=2)
+        big = make_host_text(0, chips=8)
+        pages = {"h0:8000": small}
+        store = SnapshotStore()
+        agg = SliceAggregator(("h0:8000",), store, fetch=StaticFetch(pages))
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        try:
+            agg.poll_once()
+            assert store.current().value("tpu_slice_chip_count", key) == 2.0
+            (layout,) = agg._parse_layouts.values()
+            assert layout.entries and not layout.oversize_logged
+            # Shrink the cap under the CURRENT body so the next round is
+            # oversize without needing a 32k-line fixture.
+            layout.max_entries = small.count("\n") // 2
+            pages["h0:8000"] = big
+            agg.poll_once()
+            assert store.current().value("tpu_slice_chip_count", key) == 8.0
+            assert layout.oversize_logged and layout.entries == []
+            agg.poll_once()  # steady-state oversize round
+            assert store.current().value("tpu_slice_chip_count", key) == 8.0
+            layout.max_entries = 32768
+            pages["h0:8000"] = small
+            agg.poll_once()  # shrink-back: re-enters the cache
+            assert store.current().value("tpu_slice_chip_count", key) == 2.0
+            assert layout.entries and not layout.oversize_logged
+            agg.poll_once()  # warm round on the re-cached layout
+            assert store.current().value("tpu_slice_chip_count", key) == 2.0
+        finally:
+            agg.close()
 
     def test_oversize_target_distinguishable_from_down(self):
         # layout_entries=0 is ambiguous (down vs deliberately uncached);

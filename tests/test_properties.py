@@ -376,3 +376,57 @@ class TestLayoutParserDifferential:
                         f"layout parser accepted what reference rejects: {text!r}"
                     )
                 assert layout.entries is entries_before  # cache untouched
+
+    @given(
+        bodies=st.lists(st.lists(_line, max_size=12), min_size=1, max_size=4),
+        cap=st.integers(min_value=1, max_value=10),
+    )
+    @settings(
+        max_examples=150, deadline=None,
+        suppress_health_check=[HealthCheck.function_scoped_fixture],
+    )
+    def test_layout_parser_matches_reference_under_tiny_cache_cap(
+        self, bodies, cap
+    ):
+        """Same differential, but with a cap small enough that bodies cross
+        it freely — the oversize fast path, the small↔oversize transitions,
+        and the flag state machine all get fuzzed. Invariants after every
+        successful round: results equal the reference parser's regardless
+        of cache state; oversize_logged mirrors whether THIS body was over
+        the cap; an oversize round leaves nothing cached. After a
+        ParseError round: every piece of cache state is untouched."""
+        from tpu_pod_exporter.metrics.parse import (
+            LayoutCache,
+            ParseError,
+            parse_exposition,
+            parse_exposition_layout,
+        )
+
+        names = frozenset({"m", "tpu_x"})
+        layout = LayoutCache(max_entries=cap)
+        for lines in bodies:
+            text = "\n".join(lines) + "\n"
+            over = text.count("\n") + 1 > cap
+            try:
+                want = [
+                    (s.name, s.labels, s.value)
+                    for s in parse_exposition(text, names=names)
+                ]
+                want_err = None
+            except ParseError as e:
+                want, want_err = None, e
+            if want_err is None:
+                got = parse_exposition_layout(text, names, layout)
+                assert [tuple(s) for s in got] == want, text
+                assert layout.oversize_logged == over, text
+                if over:
+                    assert layout.entries == []
+                    assert layout.native_built_for is None
+                    assert layout.samples_template is None
+            else:
+                entries_before = layout.entries
+                flag_before = layout.oversize_logged
+                with self._pytest.raises(ParseError):
+                    parse_exposition_layout(text, names, layout)
+                assert layout.entries is entries_before
+                assert layout.oversize_logged == flag_before
