@@ -211,6 +211,11 @@ def main() -> int:
             "burst_served_per_s": round(served / burst_wall_s, 1),
             "burst_rejected_per_s": round(rejected / burst_wall_s, 1),
             "scrapes": scrapes,
+            # Latency and CPU are strongly machine-dependent (a 1-core CI
+            # host roughly doubles p99 vs a multi-core box because scrapes
+            # collide with the poll); record the hardware so cross-round
+            # BENCH_r{N}.json comparisons aren't misread as regressions.
+            "cpu_cores": os.cpu_count(),
         }
         print(json.dumps(result))
         return 0

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import statistics
 import sys
 import time
@@ -77,6 +78,8 @@ def main(argv=None) -> int:
         # (≈ one parsed body's strings per target — the cost of the
         # value-only re-parse path; BASELINE.md documents the trade).
         "rss_mb": round(_rss_bytes() / 1e6, 1),
+        # Machine context for cross-round comparisons (see bench.py).
+        "cpu_cores": os.cpu_count(),
     }))
     return 0
 
