@@ -973,6 +973,37 @@ class TestAggregatorCli:
             app.stop()
 
 
+class TestLabelStringMemo:
+    """Label strings are deduplicated through a bounded memo (NOT
+    sys.intern, whose table never releases — a slow leak under pod-name
+    churn). Dedup must be observable, the bound enforced by wholesale
+    clear, and degenerate strings excluded."""
+
+    def test_identical_values_share_one_string_across_blocks(self):
+        from tpu_pod_exporter.metrics.parse import parse_families
+
+        # The two blocks must DIFFER (chip 7 vs 8): byte-identical blocks
+        # already share strings via the block cache's shallow copy, which
+        # would pass even with the memo reverted (code-review r5). Only
+        # the memo can dedup the repeated pod value across distinct blocks.
+        body = 'm{pod="train-0",chip="7"} 1\nm2{pod="train-0",chip="8"} 2\n'
+        fams = parse_families(body)
+        (s1,), (s2,) = fams["m"], fams["m2"]
+        assert s1.labels["pod"] is s2.labels["pod"]  # same object via memo
+        assert s1.labels["chip"] == "7" and s2.labels["chip"] == "8"
+
+    def test_memo_bounded_and_skips_oversize(self):
+        from tpu_pod_exporter.metrics import parse as parse_mod
+
+        parse_mod._STR_MEMO.clear()
+        huge = "x" * (parse_mod._STR_MEMO_MAX_LEN + 1)
+        assert parse_mod._memo_str(huge) == huge
+        assert huge not in parse_mod._STR_MEMO  # degenerate value excluded
+        for i in range(parse_mod._STR_MEMO_MAX + 10):
+            parse_mod._memo_str(f"v{i}")
+        assert len(parse_mod._STR_MEMO) <= parse_mod._STR_MEMO_MAX
+
+
 class TestLayoutParser:
     """parse_exposition_layout: value-only re-parse between churn events
     (VERDICT r4 #6 — the parse-side twin of the exporter's PrefixCache)."""
