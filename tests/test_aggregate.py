@@ -364,7 +364,11 @@ class TestParserRobustness:
         assert len(s.labels["a"]) == 5000
         assert ('a="' + "y" * 5000 + '"') not in parse_mod._BLOCK_CACHE
 
-    def test_block_cache_returns_fresh_copies(self):
+    def test_parse_exposition_callers_own_labels(self):
+        # The ownership copy lives at the parse_exposition boundary (the
+        # block cache itself hands out SHARED dicts — layout entries and
+        # every line with the same block reuse one object): a caller
+        # mutating its ParsedSample.labels must not corrupt later parses.
         text = 'm{a="x"} 1\n'
         (s1,) = parse_exposition(text)
         s1.labels["mutated"] = "yes"

@@ -204,8 +204,13 @@ def _parse_label_block(block: str, line: str) -> dict[str, str]:
                 if block not in _BLOCK_CACHE:  # a racing miss already paid
                     _BLOCK_CACHE[block] = cached
                     _block_cache_bytes += _entry_cost(block)
-    # Copy: callers own their labels dict (ParsedSample is public API).
-    return dict(cached)
+    # SHARED return: the same dict object serves every line with this
+    # block (across targets, too). The layout path's contract already
+    # declares labels shared-and-frozen, and the per-line dict(cached)
+    # copies were ~45 MiB at the 64x256 stress shape; the one public
+    # copy-owning API (parse_exposition / ParsedSample) copies at its own
+    # boundary instead.
+    return cached
 
 
 def _parse_line(line: str, names):
@@ -214,7 +219,10 @@ def _parse_line(line: str, names):
     ``(2, prefix, name, labels, value)``. Raises ParseError. The SINGLE
     definition of the line grammar — both :func:`parse_exposition` and
     :func:`parse_exposition_layout`'s slow path call it, so the two
-    parsers cannot drift apart (code-review r5)."""
+    parsers cannot drift apart (code-review r5). ``labels`` is SHARED
+    with the block cache (and with every other line using the same
+    block): treat as frozen; copy at any boundary that hands ownership
+    out."""
     if line[-1] == "{":
         raise ParseError(f"truncated line: {line!r}")
     brace = line.find("{")
@@ -226,6 +234,11 @@ def _parse_line(line: str, names):
         prefix = line[: close + 1]
         if names is not None and name not in names:
             return (1, prefix)
+        # Family names repeat on nearly every line of a body; memoized so
+        # 290k cached entries at slice scale share a handful of strings.
+        # After the filter: a kind-1 entry drops the name, and dead
+        # memo slots would hasten the wholesale clear (code-review r5).
+        name = _memo_str(name)
         labels = _parse_label_block(line[brace + 1 : close], line)
         rest = line[close + 1 :].strip()
     else:
@@ -236,6 +249,7 @@ def _parse_line(line: str, names):
         prefix = name
         if names is not None and name not in names:
             return (1, prefix)
+        name = _memo_str(name)  # post-filter, same rationale as above
         labels = {}
     if not name:
         raise ParseError(f"missing metric name: {line!r}")
@@ -271,7 +285,10 @@ def parse_exposition(
             continue
         ent = _parse_line(line, names)
         if ent[0] == 2:
-            yield ParsedSample(ent[2], ent[3], ent[4])
+            # Copy here, at the public boundary: ParsedSample callers own
+            # their labels dict; _parse_line's is shared with the block
+            # cache and with other lines using the same block.
+            yield ParsedSample(ent[2], dict(ent[3]), ent[4])
 
 
 class LayoutCache:
