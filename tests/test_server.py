@@ -617,3 +617,56 @@ def test_scrape_rejects_surface_as_self_metric():
         assert b'tpu_exporter_scrape_rejects_total{cause="rate"} 3\n' in body
     finally:
         app.stop()
+
+
+class TestDebugStacks:
+    """/debug/stacks — the pprof-equivalent SURVEY §5 asks for: a
+    point-in-time dump of every thread's Python stack, served from a
+    handler thread so it works even while another thread is wedged."""
+
+    def test_wedged_thread_visible_with_blocking_site(self, served_store):
+        import threading
+        import time
+
+        _, base = served_store
+        started = threading.Event()
+        release = threading.Event()
+
+        def wedged_poll():
+            started.set()
+            release.wait()  # the "hung backend call"
+
+        t = threading.Thread(target=wedged_poll, name="fake-poll", daemon=True)
+        t.start()
+        try:
+            assert started.wait(timeout=5)
+            # started.set() only proves the thread entered wedged_poll();
+            # retry briefly until the dump catches it AT the wait site
+            # (a loaded box can serve the first GET mid-bootstrap).
+            text = ""
+            for _ in range(50):
+                status, headers, body = get(base + "/debug/stacks")
+                assert status == 200
+                assert headers["Content-Type"].startswith("text/plain")
+                text = body.decode()
+                if "release.wait()" in text:
+                    break
+                time.sleep(0.05)
+            assert "(fake-poll)" in text
+            # The dump must show WHERE the thread is blocked, not just that
+            # it exists — that's the whole diagnostic value.
+            assert "release.wait()" in text
+            assert "in wedged_poll" in text
+        finally:
+            release.set()
+            t.join(timeout=5)
+
+    def test_every_live_thread_listed(self, served_store):
+        import threading
+
+        _, base = served_store
+        _, _, body = get(base + "/debug/stacks")
+        text = body.decode()
+        # The handler thread serving this very request is live too.
+        assert text.count("--- thread ") >= 1
+        assert f"({threading.main_thread().name})" in text

@@ -26,6 +26,32 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from tpu_pod_exporter.metrics import SnapshotStore
 
+
+def _format_stacks() -> str:
+    """Every live thread's Python stack, one block per thread.
+
+    ``sys._current_frames`` is a documented-CPython atomic snapshot (the
+    dict is built under the GIL); traceback formatting walks frame objects
+    that stay valid while referenced, so a wedged thread's stack renders
+    even though that thread never cooperates."""
+    import sys
+    import traceback
+
+    frames = sys._current_frames()
+    by_id = {t.ident: t for t in threading.enumerate()}
+    out = []
+    for ident, frame in sorted(frames.items()):
+        t = by_id.get(ident)
+        name = t.name if t else "?"
+        daemon = " daemon" if (t and t.daemon) else ""
+        out.append(f"--- thread {ident} ({name}){daemon} ---")
+        out.extend(
+            line.rstrip("\n")
+            for line in traceback.format_stack(frame)
+        )
+        out.append("")
+    return "\n".join(out) + "\n"
+
 log = logging.getLogger("tpu_pod_exporter.server")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -175,6 +201,16 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/stacks":
+            # The pprof-equivalent SURVEY §5 asks for, sized to this
+            # process: a point-in-time dump of every thread's Python stack.
+            # THE tool for the wedge /healthz detects — `curl
+            # /debug/stacks` from the node shows exactly where a stuck
+            # poll thread is blocked (a hung gRPC call, a dead NFS mount)
+            # without kubectl exec, a debugger, or signals. Read-only,
+            # allocation-light, served even while the poll thread is
+            # wedged because handlers run on their own threads.
+            self._serve_text(200, _format_stacks().encode())
         elif path == "/healthz":
             snap = self.store.current()
             if (
