@@ -947,11 +947,17 @@ class TestAggregatorCli:
         s.bind(("127.0.0.1", 0))
         agg_port = s.getsockname()[1]
         s.close()
+        import tempfile
+
+        logf = tempfile.NamedTemporaryFile(
+            mode="w+", suffix=".log", delete=False
+        )
         proc = subprocess.Popen(
             [sys.executable, "-m", "tpu_pod_exporter.aggregate",
              "--targets", f"127.0.0.1:{app.port}",
              "--host", "127.0.0.1", "--port", str(agg_port),
-             "--interval-s", "0.2"],
+             "--interval-s", "0.2", "--log-format", "json"],
+            stderr=logf,
         )
         try:
             deadline = time.monotonic() + 20
@@ -971,6 +977,20 @@ class TestAggregatorCli:
             assert "tpu_aggregator_target_up" in body
             proc.send_signal(signal.SIGTERM)
             assert proc.wait(timeout=15) == 0  # clean drain
+            # --log-format json end to end: every emitted CLI log line is
+            # a Cloud-Logging-shaped object (severity + message).
+            import json as json_mod
+            import os
+
+            logf.flush()
+            lines = [
+                ln for ln in open(logf.name).read().splitlines() if ln.strip()
+            ]
+            assert lines, "aggregator emitted no log lines"
+            for ln in lines:
+                obj = json_mod.loads(ln)
+                assert "severity" in obj and "message" in obj, ln
+            os.unlink(logf.name)
         finally:
             if proc.poll() is None:
                 proc.kill()

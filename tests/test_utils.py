@@ -59,3 +59,106 @@ class TestRateLimitedLogger:
         rl.info("i", "info-msg")
         rl.error("e", "error-msg")
         assert records == ["info-msg", "error-msg"]
+
+
+class TestJsonLogging:
+    def _record(self, logger="t", level=logging.WARNING, msg="hello %s",
+                args=("world",), exc_info=None):
+        return logging.LogRecord(
+            logger, level, "f.py", 1, msg, args, exc_info
+        )
+
+    def test_json_lines_are_valid_and_cloud_shaped(self):
+        import json
+
+        from tpu_pod_exporter.utils import JsonLogFormatter
+
+        line = JsonLogFormatter().format(self._record())
+        obj = json.loads(line)
+        assert obj["severity"] == "WARNING"  # the key GKE promotes
+        assert obj["message"] == "hello world"
+        assert obj["logger"] == "t"
+        assert "time" in obj
+        assert "\n" not in line  # one line per record, always
+
+    def test_hostile_message_cannot_break_line_framing(self):
+        import json
+
+        from tpu_pod_exporter.utils import JsonLogFormatter
+
+        nasty = 'pod "a\nb\\c"   died'
+        line = JsonLogFormatter().format(
+            self._record(msg="%s", args=(nasty,))
+        )
+        assert "\n" not in line
+        assert json.loads(line)["message"] == nasty
+
+    def test_exception_info_included(self):
+        import json
+        import sys
+
+        from tpu_pod_exporter.utils import JsonLogFormatter
+
+        try:
+            raise ValueError("boom")
+        except ValueError:
+            rec = self._record(msg="failed", args=(), exc_info=sys.exc_info())
+        obj = json.loads(JsonLogFormatter().format(rec))
+        assert "ValueError: boom" in obj["exception"]
+
+    def test_time_field_is_rfc3339_utc(self):
+        import json
+        import re
+
+        from tpu_pod_exporter.utils import JsonLogFormatter
+
+        obj = json.loads(JsonLogFormatter().format(self._record()))
+        # Strict Cloud Logging parsers need a colon in the offset and
+        # benefit from sub-second precision for burst ordering.
+        assert re.fullmatch(
+            r"\d{4}-\d{2}-\d{2}T\d{2}:\d{2}:\d{2}\.\d+\+00:00", obj["time"]
+        ), obj["time"]
+
+    def test_setup_logging_json_branch_installs_formatter(self, monkeypatch):
+        from tpu_pod_exporter import utils as U
+
+        captured = {}
+        monkeypatch.setattr(
+            logging, "basicConfig", lambda **kw: captured.update(kw)
+        )
+        U.setup_logging("warning", "json")
+        assert captured["level"] == logging.WARNING
+        (handler,) = captured["handlers"]
+        assert isinstance(handler.formatter, U.JsonLogFormatter)
+        # Case-insensitive accept; unknown value is a loud startup error,
+        # never a silent fallback to text (code-review r5).
+        captured.clear()
+        U.setup_logging("info", "JSON")
+        assert "handlers" in captured
+        import pytest as _pytest
+
+        with _pytest.raises(ValueError, match="log-format"):
+            U.setup_logging("info", "jsonl")
+
+    def test_setup_logging_json_emits_parseable_lines(self):
+        import io
+        import json
+
+        from tpu_pod_exporter.utils import JsonLogFormatter
+
+        # Drive a real handler pipeline (not basicConfig, which pytest's
+        # root logger would fight over): formatter + stream end to end.
+        # The setup_logging branch itself is covered above; the CLI e2e
+        # path is covered by the subprocess smoke in test_integration.
+        stream = io.StringIO()
+        h = logging.StreamHandler(stream)
+        h.setFormatter(JsonLogFormatter())
+        lg = logging.getLogger("tpe-json-test")
+        lg.addHandler(h)
+        lg.setLevel(logging.INFO)
+        try:
+            lg.info("round %d done", 7)
+        finally:
+            lg.removeHandler(h)
+        (line,) = stream.getvalue().splitlines()
+        assert json.loads(line)["message"] == "round 7 done"

@@ -518,11 +518,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--max-scrapes-per-s", type=float, default=100.0,
                    help="rate-cap own /metrics (token bucket; 0 disables)")
     p.add_argument("--log-level", default="info")
+    p.add_argument("--log-format", default="text", choices=("text", "json"),
+                   help="json = one Cloud-Logging-shaped object per line")
     ns = p.parse_args(argv)
-    logging.basicConfig(
-        level=getattr(logging, ns.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    utils.setup_logging(ns.log_level, ns.log_format)
 
     targets = tuple(t.strip() for t in ns.targets.split(",") if t.strip())
     store = SnapshotStore()

@@ -13,6 +13,7 @@ import signal
 import threading
 import time
 
+from tpu_pod_exporter import utils
 from tpu_pod_exporter.attribution import AttributionProvider
 from tpu_pod_exporter.attribution.fake import FakeAttribution
 from tpu_pod_exporter.backend import DeviceBackend
@@ -289,10 +290,7 @@ class ExporterApp:
 
 def main(argv: list[str] | None = None) -> int:
     cfg = ExporterConfig.from_args(argv)
-    logging.basicConfig(
-        level=getattr(logging, cfg.log_level.upper(), logging.INFO),
-        format="%(asctime)s %(levelname)s %(name)s: %(message)s",
-    )
+    utils.setup_logging(cfg.log_level, cfg.log_format)
     app = ExporterApp(cfg)
     stop = threading.Event()
 

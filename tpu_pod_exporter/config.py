@@ -61,6 +61,11 @@ class ExporterConfig:
     # per-chip series.
     multislice_group: str = ""
     log_level: str = "info"
+    # "text" (human console) or "json": one JSON object per line with a
+    # `severity` field — the shape GKE's Cloud Logging agent parses
+    # natively, so exporter WARNINGs become filterable log entries instead
+    # of opaque text blobs.
+    log_format: str = "text"
 
     @staticmethod
     def _env_default(name: str, fallback):

@@ -88,3 +88,58 @@ def process_rss_bytes() -> float | None:
         return float(pages * _PAGE_SIZE)
     except Exception:  # noqa: BLE001
         return None
+
+
+# --- Logging setup ----------------------------------------------------------
+
+
+class JsonLogFormatter(logging.Formatter):
+    """One JSON object per line, Cloud-Logging-shaped.
+
+    ``severity`` (not ``levelname``) is the key GKE's logging agent
+    promotes to a first-class field, which makes exporter warnings
+    filterable/alertable in a fleet instead of being grepped out of text
+    blobs. json.dumps handles every escape (quotes, newlines in tracebacks,
+    non-UTF8-able code points) — a malformed pod name can't corrupt the
+    log stream's line framing.
+    """
+
+    def format(self, record: logging.LogRecord) -> str:
+        import json
+        from datetime import datetime, timezone
+
+        # RFC3339 with sub-second precision and a colon in the offset
+        # ("+00:00") — strftime's %z yields "+0000", which strict Cloud
+        # Logging parsers reject, silently falling back to ingestion time
+        # exactly when ordering matters (code-review r5).
+        ts = datetime.fromtimestamp(record.created, timezone.utc).isoformat()
+        out = {
+            "severity": record.levelname,
+            "time": ts,
+            "logger": record.name,
+            "message": record.getMessage(),
+        }
+        if record.exc_info:
+            out["exception"] = self.formatException(record.exc_info)
+        return json.dumps(out, ensure_ascii=False, default=str)
+
+
+def setup_logging(level: str, fmt: str = "text") -> None:
+    """Root-logger setup shared by the exporter and aggregator CLIs.
+
+    Unknown ``fmt`` raises instead of silently degrading to text: an
+    operator who set TPE_LOG_FORMAT=JSONL must find out at startup, not
+    when Cloud Logging keeps showing unparsed blobs mid-incident."""
+    lvl = getattr(logging, level.upper(), logging.INFO)
+    fmt = fmt.lower()
+    if fmt == "json":
+        handler = logging.StreamHandler()
+        handler.setFormatter(JsonLogFormatter())
+        logging.basicConfig(level=lvl, handlers=[handler])
+    elif fmt == "text":
+        logging.basicConfig(
+            level=lvl,
+            format="%(asctime)s %(levelname)s %(name)s: %(message)s",
+        )
+    else:
+        raise ValueError(f"--log-format must be 'text' or 'json', got {fmt!r}")
