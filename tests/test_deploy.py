@@ -79,3 +79,20 @@ def test_daemonset_probes_match_server_endpoints():
     container = ds["spec"]["template"]["spec"]["containers"][0]
     assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
     assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+
+
+def test_every_alert_has_a_runbook_entry():
+    """An alert without triage guidance pages someone with nowhere to go;
+    RUNBOOK.md must gain an entry whenever prometheus-rules.yaml gains an
+    alert (and stale entries for deleted alerts should be pruned)."""
+    import re
+
+    rules = (DEPLOY / "prometheus-rules.yaml").read_text()
+    runbook = (DEPLOY / "RUNBOOK.md").read_text()
+    alerts = re.findall(r"- alert: (\w+)", rules)
+    assert alerts, "no alerts found — regex or file moved?"
+    missing = [a for a in alerts if f"## {a}" not in runbook]
+    assert not missing, f"alerts without runbook entries: {missing}"
+    documented = re.findall(r"^## (\w+)", runbook, flags=re.M)
+    stale = [d for d in documented if d not in alerts]
+    assert not stale, f"runbook entries for nonexistent alerts: {stale}"
