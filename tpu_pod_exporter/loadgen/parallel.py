@@ -9,6 +9,10 @@ metrics must observe):
 - **Ring attention** (sequence/context parallel): K/V blocks rotate around
   the mesh via ``lax.ppermute`` while a flash-style running softmax
   accumulates — neighbor-only ICI traffic, the long-context pattern.
+- **Ulysses attention** (sequence parallel, all_to_all flavor): one
+  ``all_to_all`` swaps the sequence shard for a head shard, exact
+  attention runs per head on the full sequence, a second ``all_to_all``
+  swaps back — two bulk crossbar bursts instead of n ppermute hops.
 - **Pipeline parallel**: GPipe-style microbatch schedule; activations hop
   stage→stage via ``ppermute`` — directional neighbor traffic with bubbles.
 - **Expert parallel (MoE)**: tokens ``lax.all_to_all`` to their expert's
@@ -19,7 +23,7 @@ metrics must observe):
   (DCN-class axis) over intra-slice tensor parallelism (ICI-class axis) —
   BASELINE config 5's compute shape.
 
-All five are ``jax.shard_map`` programs with compiler-visible collectives
+All six are ``jax.shard_map`` programs with compiler-visible collectives
 (no data-dependent Python control flow), verified numerically against their
 single-device references in ``tests/test_parallel.py`` on the virtual CPU
 mesh, and composed into the driver's multi-chip dry run
@@ -108,6 +112,48 @@ def ring_attention_fn(mesh, axis: str = "seq"):
             out_specs=seq_sharded)
     sharding = NamedSharding(mesh, seq_sharded)
     return jax.jit(fn), sharding
+
+
+def reference_mha(q, k, v):
+    """Per-head softmax attention on full (T, H, d) tensors — ground truth
+    for :func:`ulysses_attention_fn`. Deliberately vmap of
+    :func:`reference_attention` over the head axis: ONE definition of the
+    ground-truth attention math, so a stability/precision tweak there can
+    never silently diverge from this one."""
+    import jax
+
+    return jax.vmap(reference_attention, in_axes=1, out_axes=1)(q, k, v)
+
+
+def ulysses_attention_fn(mesh, axis: str = "seq"):
+    """Ulysses-style sequence parallelism (DeepSpeed-Ulysses): q/k/v are
+    sharded along the SEQUENCE axis; one ``all_to_all`` re-shards them to
+    HEAD-parallel so each device computes exact full-sequence attention
+    for its own heads, and a second ``all_to_all`` restores sequence
+    sharding. The complementary recipe to :func:`ring_attention_fn` —
+    two bulk all-to-alls instead of n ppermute hops, with no device ever
+    holding all heads AND all sequence. Requires heads % n_devices == 0.
+
+    Returns ``fn(q, k, v) -> out`` over (T, H, d) tensors sharded
+    ``P(axis, None, None)``."""
+    import jax
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    def local(q, k, v):
+        # q/k/v local: (T/n, H, d). all_to_all: split heads, gather seq
+        # → (T, H/n, d): full sequence for this device's head group.
+        qh = lax.all_to_all(q, axis, split_axis=1, concat_axis=0, tiled=True)
+        kh = lax.all_to_all(k, axis, split_axis=1, concat_axis=0, tiled=True)
+        vh = lax.all_to_all(v, axis, split_axis=1, concat_axis=0, tiled=True)
+        out = reference_mha(qh, kh, vh)  # exact attention, local heads
+        # Inverse all_to_all: split seq, gather heads → (T/n, H, d).
+        return lax.all_to_all(out, axis, split_axis=0, concat_axis=1, tiled=True)
+
+    sm = _shard_map()
+    spec = P(axis, None, None)
+    fn = sm(local, mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec)
+    return jax.jit(fn), NamedSharding(mesh, spec)
 
 
 # ----------------------------------------------------------------- pipeline
@@ -401,6 +447,20 @@ def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
     k = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) + 1, sharding)
     v = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) - 1, sharding)
     results["ring_attention"] = float(jnp.sum(fn(q, k, v)))
+
+    # SP (all_to_all flavor): Ulysses head-swap attention on the same ring.
+    fn, sharding = ulysses_attention_fn(mesh)
+    heads = n_devices  # heads % n_devices == 0
+    qm = jax.device_put(
+        jax.random.normal(key, (t, heads, d), jnp.float32), sharding
+    )
+    km = jax.device_put(
+        jax.random.normal(key, (t, heads, d), jnp.float32) + 1, sharding
+    )
+    vm = jax.device_put(
+        jax.random.normal(key, (t, heads, d), jnp.float32) - 1, sharding
+    )
+    results["ulysses_attention"] = float(jnp.sum(fn(qm, km, vm)))
 
     # PP: microbatched pipeline over a "stage" chain.
     mesh = make_1d_mesh(n_devices, "stage")

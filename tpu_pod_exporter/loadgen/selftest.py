@@ -148,6 +148,29 @@ def check_ring_attention_stability(n: int) -> dict:
     return {**res, "ok": finite and res["ok"], "finite": finite}
 
 
+def check_ulysses_attention(n: int) -> dict:
+    """Ulysses head-swap SP vs exact multi-head attention: the two
+    all_to_alls must be inverses and the per-head math exact."""
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_pod_exporter.loadgen.parallel import (
+        make_1d_mesh,
+        reference_mha,
+        ulysses_attention_fn,
+    )
+
+    mesh = make_1d_mesh(n, "seq")
+    fn, sharding = ulysses_attention_fn(mesh)
+    t, h, d = 4 * n, 2 * n, 16  # heads a strict multiple of devices
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(11), 3)
+    q = jax.random.normal(k1, (t, h, d), jnp.float32)
+    k = jax.random.normal(k2, (t, h, d), jnp.float32)
+    v = jax.random.normal(k3, (t, h, d), jnp.float32)
+    out = fn(*(jax.device_put(a, sharding) for a in (q, k, v)))
+    return _close(out, reference_mha(q, k, v), rtol=2e-5, atol=2e-5)
+
+
 def check_pipeline(n: int) -> dict:
     import jax
     import jax.numpy as jnp
@@ -289,6 +312,7 @@ CHECKS = {
     "dryrun_parallelism": check_dryrun_parallelism,
     "ring_attention": check_ring_attention,
     "ring_attention_stability": check_ring_attention_stability,
+    "ulysses_attention": check_ulysses_attention,
     "pipeline": check_pipeline,
     "moe": check_moe,
     "fsdp": check_fsdp,
