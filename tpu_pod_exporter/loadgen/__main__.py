@@ -4,6 +4,7 @@ Examples:
     python -m tpu_pod_exporter.loadgen --mode burn --seconds 30
     python -m tpu_pod_exporter.loadgen --mode hbm --gib 8 --seconds 60
     python -m tpu_pod_exporter.loadgen --mode sharded --devices 4 --seconds 30
+    python -m tpu_pod_exporter.loadgen --mode parallel --program ulysses --seconds 30
 """
 
 from __future__ import annotations
@@ -14,8 +15,23 @@ import time
 
 
 def main(argv=None) -> int:
+    # Cheap import: parallel.py has no top-level jax dependency, and
+    # choices= makes a typo'd program name an instant argparse error
+    # instead of a traceback after tens of seconds of TPU backend init.
+    from tpu_pod_exporter.loadgen.parallel import PARALLEL_PROGRAMS
+
     p = argparse.ArgumentParser(prog="tpu-loadgen", description=__doc__)
-    p.add_argument("--mode", choices=("burn", "hbm", "sharded"), default="burn")
+    p.add_argument(
+        "--mode", choices=("burn", "hbm", "sharded", "parallel"), default="burn"
+    )
+    p.add_argument(
+        "--program", default="ring", choices=PARALLEL_PROGRAMS,
+        help="parallel mode: which collective pattern to loop",
+    )
+    p.add_argument(
+        "--scale", type=int, default=1,
+        help="parallel mode: tensor-dimension multiplier (ICI bytes/step)",
+    )
     p.add_argument("--seconds", type=float, default=10.0)
     p.add_argument("--width", type=int, default=1024)
     p.add_argument("--depth", type=int, default=8)
@@ -63,6 +79,37 @@ def main(argv=None) -> int:
         dt = time.monotonic() - t0
         flops = 2 * args.batch * args.width * args.width * args.depth * args.iters * steps
         print(f"{steps} steps in {dt:.1f}s → {flops / dt / 1e12:.2f} TFLOP/s")
+        return 0
+
+    if args.mode == "parallel":
+        import jax.numpy as jnp
+
+        from tpu_pod_exporter.loadgen.parallel import build_parallel_program
+
+        n = args.devices or len(jax.devices())
+        step, inputs, feed = build_parallel_program(
+            args.program, n, scale=args.scale
+        )
+        out = step(*inputs)  # compile
+        jax.block_until_ready(out)
+        t0 = time.monotonic()
+        deadline = t0 + args.seconds
+        while time.monotonic() < deadline:
+            out = step(*inputs)
+            inputs = feed(inputs, out)
+            # Host readback — the sync some experimental runtimes honor
+            # (see burn mode); also catches a NaN'd feedback loop early.
+            leaf = out[0] if isinstance(out, tuple) else out
+            probe = float(jnp.ravel(leaf)[0])
+            if probe != probe:
+                print(f"NaN after {steps} steps", file=sys.stderr)
+                return 1
+            steps += 1
+        dt = time.monotonic() - t0
+        print(
+            f"{args.program} x{args.scale} on {n} devices: "
+            f"{steps} steps in {dt:.1f}s → {steps / dt:.1f} steps/s"
+        )
         return 0
 
     # sharded

@@ -429,91 +429,129 @@ def reference_multislice(w, x, lr: float = 0.1):
 
 # ------------------------------------------------------------------- dryrun
 
-def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
-    """Compile + execute one step of each strategy on an n-device mesh with
-    tiny shapes. Returns a finite checksum per strategy (the driver asserts
-    non-NaN); used by ``__graft_entry__.dryrun_multichip``."""
+PARALLEL_PROGRAMS = (
+    "ring", "ulysses", "pipeline", "moe", "fsdp", "multislice",
+)
+
+
+def build_parallel_program(name: str, n_devices: int, scale: int = 1):
+    """One named strategy packaged for CLI looping on live hardware:
+    returns ``(step, args, feed)`` where ``step(*args)`` runs one
+    iteration and ``feed(args, out) -> args`` threads the output back in
+    as the next input — a real data dependency per step, so no runtime
+    can elide repeated identical executions (same trick as burn mode).
+    ``scale`` multiplies the tensor dimensions (ICI bytes/step) without
+    changing the collective pattern."""
     import jax
     import jax.numpy as jnp
 
-    results: dict[str, float] = {}
-
-    # SP: ring attention over a "seq" ring.
-    mesh = make_1d_mesh(n_devices, "seq")
-    fn, sharding = ring_attention_fn(mesh)
-    t, d = 4 * n_devices, 8
+    if name not in PARALLEL_PROGRAMS:
+        raise ValueError(f"unknown program {name!r}; pick from {PARALLEL_PROGRAMS}")
     key = jax.random.PRNGKey(0)
-    q = jax.device_put(jax.random.normal(key, (t, d), jnp.float32), sharding)
-    k = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) + 1, sharding)
-    v = jax.device_put(jax.random.normal(key, (t, d), jnp.float32) - 1, sharding)
-    results["ring_attention"] = float(jnp.sum(fn(q, k, v)))
+    n = n_devices
 
-    # SP (all_to_all flavor): Ulysses head-swap attention on the same ring.
-    fn, sharding = ulysses_attention_fn(mesh)
-    heads = n_devices  # heads % n_devices == 0
-    qm = jax.device_put(
-        jax.random.normal(key, (t, heads, d), jnp.float32), sharding
-    )
-    km = jax.device_put(
-        jax.random.normal(key, (t, heads, d), jnp.float32) + 1, sharding
-    )
-    vm = jax.device_put(
-        jax.random.normal(key, (t, heads, d), jnp.float32) - 1, sharding
-    )
-    results["ulysses_attention"] = float(jnp.sum(fn(qm, km, vm)))
+    if name == "ring":
+        mesh = make_1d_mesh(n, "seq")
+        fn, sharding = ring_attention_fn(mesh)
+        t, d = 4 * n * scale, 8 * scale
+        q, k, v = (
+            jax.device_put(jax.random.normal(key, (t, d), jnp.float32), sharding)
+            for _ in range(3)
+        )
+        return fn, (q, k, v), lambda a, out: (out, a[1], a[2])
 
-    # PP: microbatched pipeline over a "stage" chain.
-    mesh = make_1d_mesh(n_devices, "stage")
-    n_micro = 2 * n_devices
-    fn, w_sharding = pipeline_forward_fn(mesh)
-    width, mb = 8, 4
-    stage_w = jax.device_put(
-        jax.random.normal(key, (n_devices, width, width), jnp.float32) * 0.5,
-        w_sharding,
-    )
-    xs = jax.random.normal(key, (n_micro, mb, width), jnp.float32)
-    results["pipeline"] = float(jnp.sum(fn(stage_w, xs)))
+    if name == "ulysses":
+        mesh = make_1d_mesh(n, "seq")
+        fn, sharding = ulysses_attention_fn(mesh)
+        t, h, d = 4 * n * scale, n, 8 * scale
+        q, k, v = (
+            jax.device_put(
+                jax.random.normal(key, (t, h, d), jnp.float32), sharding
+            )
+            for _ in range(3)
+        )
+        return fn, (q, k, v), lambda a, out: (out, a[1], a[2])
 
-    # EP: MoE all_to_all over an "expert" axis (own dims — each strategy
-    # block is self-contained).
-    mesh = make_1d_mesh(n_devices, "expert")
-    fn, w_sharding, x_sharding = moe_forward_fn(mesh)
-    d_moe = 8
-    tokens = n_devices * n_devices * 2  # t_local divisible by n_exp
-    expert_w = jax.device_put(
-        jax.random.normal(key, (n_devices, d_moe, d_moe), jnp.float32) * 0.5,
-        w_sharding,
-    )
-    x = jax.device_put(
-        jax.random.normal(key, (tokens, d_moe), jnp.float32), x_sharding
-    )
-    results["moe"] = float(jnp.sum(fn(expert_w, x)))
+    if name == "pipeline":
+        mesh = make_1d_mesh(n, "stage")
+        fn, w_sharding = pipeline_forward_fn(mesh)
+        width, mb, n_micro = 8 * scale, 4 * scale, 2 * n
+        stage_w = jax.device_put(
+            jax.random.normal(key, (n, width, width), jnp.float32) * 0.5,
+            w_sharding,
+        )
+        xs = jax.random.normal(key, (n_micro, mb, width), jnp.float32)
+        return fn, (stage_w, xs), lambda a, out: (a[0], jnp.tanh(out))
 
-    # FSDP: all_gather forward / reduce_scatter backward over a "shard" axis.
-    mesh = make_1d_mesh(n_devices, "shard")
-    fn, w_sharding = fsdp_step_fn(mesh)
-    d_f = 2 * n_devices
-    w = jax.device_put(
-        jax.random.normal(key, (d_f, d_f), jnp.float32) * 0.3, w_sharding
-    )
-    xb = jax.device_put(jax.random.normal(key, (4 * n_devices, d_f), jnp.float32),
-                        w_sharding)
-    yb = jax.device_put(jnp.zeros((4 * n_devices, d_f), jnp.float32), w_sharding)
-    _, loss = fn(w, xb, yb)
-    results["fsdp"] = float(loss)
-
-    # Multi-slice: cross-slice dp × intra-slice tp over a 2D mesh (the
-    # BASELINE config-5 shape; gradients cross the DCN-class axis).
-    if n_devices >= 4 and n_devices % 2 == 0:
-        mesh = make_2d_mesh(2, n_devices // 2)
-        fn, w_sh, x_sh = multislice_step_fn(mesh)
-        d_ms = 2 * n_devices
-        w = jax.device_put(
-            jax.random.normal(key, (d_ms, d_ms), jnp.float32) * 0.3, w_sh
+    if name == "moe":
+        mesh = make_1d_mesh(n, "expert")
+        fn, w_sharding, x_sharding = moe_forward_fn(mesh)
+        d = 8 * scale
+        tokens = n * n * 2 * scale
+        expert_w = jax.device_put(
+            jax.random.normal(key, (n, d, d), jnp.float32) * 0.5, w_sharding
         )
         x = jax.device_put(
-            jax.random.normal(key, (8, d_ms), jnp.float32), x_sh
+            jax.random.normal(key, (tokens, d), jnp.float32), x_sharding
         )
-        _, loss = fn(w, x)
-        results["multislice_dp_tp"] = float(loss)
+        return fn, (expert_w, x), lambda a, out: (a[0], out)
+
+    if name == "fsdp":
+        mesh = make_1d_mesh(n, "shard")
+        fn, w_sharding = fsdp_step_fn(mesh)
+        d = 2 * n * scale
+        w = jax.device_put(
+            jax.random.normal(key, (d, d), jnp.float32) * 0.3, w_sharding
+        )
+        x = jax.device_put(
+            jax.random.normal(key, (4 * n, d), jnp.float32), w_sharding
+        )
+        y = jax.device_put(
+            jax.random.normal(key, (4 * n, d), jnp.float32), w_sharding
+        )
+        return fn, (w, x, y), lambda a, out: (out[0], a[1], a[2])
+
+    # multislice: 2 slices × n//2 chips (needs even n).
+    if n % 2:
+        raise ValueError("multislice needs an even device count")
+    mesh = make_2d_mesh(2, n // 2)
+    d = max(2 * scale, 2) * (n // 2)
+    # lr scales with 1/d: the looped w <- step(w) feedback is plain
+    # gradient descent on sum(y^2), which DIVERGES to NaN once
+    # lr·λmax(2·xᵀx, psum'd over slices) exceeds 2; λmax for the (4, d)
+    # normal x grows ~(√d+2)² ≈ d, so a FIXED lr that is stable at the
+    # n=8 test shape (d=8) still NaNs on a 256-device pod or at --scale 20
+    # (observed at lr=0.1 within ~100 steps; a fixed 0.005 just moves the
+    # cliff to d≳150 — code-review r5). 0.04/d keeps ~10x margin at any d.
+    fn, w_sharding, x_sharding = multislice_step_fn(mesh, lr=0.04 / d)
+    w = jax.device_put(
+        jax.random.normal(key, (d, d), jnp.float32) * 0.2, w_sharding
+    )
+    x = jax.device_put(
+        jax.random.normal(key, (4, d), jnp.float32), x_sharding
+    )
+    return fn, (w, x), lambda a, out: (out[0], a[1])
+
+
+def run_parallelism_dryrun(n_devices: int) -> dict[str, float]:
+    """Compile + execute one step of each strategy on an n-device mesh with
+    tiny shapes. Returns a finite checksum per strategy (the driver asserts
+    non-NaN); used by ``__graft_entry__.dryrun_multichip``.
+
+    Expressed ON TOP of :func:`build_parallel_program` — the dryrun
+    verifies the exact programs the CLI loops, so mesh/shape/init config
+    exists once and cannot drift between the two (code-review r5)."""
+    import jax.numpy as jnp
+
+    # Stable external key names (driver artifacts reference them).
+    keys = {"ring": "ring_attention", "ulysses": "ulysses_attention",
+            "multislice": "multislice_dp_tp"}
+    results: dict[str, float] = {}
+    for name in PARALLEL_PROGRAMS:
+        if name == "multislice" and (n_devices < 4 or n_devices % 2):
+            continue  # needs a 2 x n/2 mesh
+        step, inputs, _feed = build_parallel_program(name, n_devices)
+        out = step(*inputs)
+        leaf = out[0] if isinstance(out, tuple) else out
+        results[keys.get(name, name)] = float(jnp.sum(leaf))
     return results
