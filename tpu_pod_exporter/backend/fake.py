@@ -14,9 +14,8 @@ is fatal, ``main.go:45-48``).
 
 from __future__ import annotations
 
-import itertools
 import threading
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Callable, Iterable, Sequence
 
 from tpu_pod_exporter.backend import (

@@ -27,7 +27,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 
 from tpu_pod_exporter.attribution import (
     AttributionError,
@@ -39,7 +39,6 @@ from tpu_pod_exporter.backend import BackendError, DeviceBackend, HostSample
 from tpu_pod_exporter.metrics import (
     CounterStore,
     HistogramStore,
-    Snapshot,
     SnapshotBuilder,
     SnapshotStore,
 )
