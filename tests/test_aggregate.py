@@ -757,6 +757,28 @@ class TestRealHardwareExposition:
         assert snap.value("tpu_slice_hbm_used_bytes", key) is None
         assert snap.value("tpu_slice_hbm_used_percent", key) is None
 
+    def test_real_aggregate_output_fixture_is_honest(self):
+        """tests/fixtures/real-aggregate-r5.txt: the AGGREGATOR's own
+        /metrics, captured while it scraped the exporter on the real
+        tunneled chip — the full pipeline (silicon → exporter →
+        aggregator) as served. The rollups must show the chip present and
+        the target up, with NO slice-HBM series fabricated from a chip
+        whose HBM was unreadable."""
+        body = (
+            Path(__file__).resolve().parent
+            / "fixtures" / "real-aggregate-r5.txt"
+        ).read_text()
+        fams = {
+            name: dict((tuple(sorted(s.labels.items())), s.value) for s in ss)
+            for name, ss in parse_families(body).items()
+        }
+        key = tuple(sorted({"slice_name": "", "accelerator": "v5e"}.items()))
+        assert fams["tpu_slice_chip_count"][key] == 1.0
+        assert fams["tpu_slice_hosts_reporting"][key] == 1.0
+        assert all(v == 1.0 for v in fams["tpu_aggregator_target_up"].values())
+        assert "tpu_slice_hbm_used_bytes" not in fams
+        assert "tpu_slice_hbm_used_percent" not in fams
+
     def test_layout_parser_roundtrips_the_real_body(self):
         from tpu_pod_exporter.metrics.parse import (
             LayoutCache,
