@@ -139,6 +139,16 @@ class TestJsonLogging:
 
         with _pytest.raises(ValueError, match="log-format"):
             U.setup_logging("info", "jsonl")
+        with _pytest.raises(ValueError, match="log-level"):
+            U.setup_logging("verbose", "json")
+        # A non-int module attribute (logging.BASIC_FORMAT is a str) must
+        # not slip through the getattr lookup as if it were a level.
+        with _pytest.raises(ValueError, match="log-level"):
+            U.setup_logging("basic_format", "text")
+        # NOTSET (0) silently means effective-WARNING on the root logger —
+        # reject it rather than drop debug/info without a word.
+        with _pytest.raises(ValueError, match="log-level"):
+            U.setup_logging("notset", "text")
 
     def test_setup_logging_json_emits_parseable_lines(self):
         import io

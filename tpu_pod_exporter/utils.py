@@ -127,10 +127,19 @@ class JsonLogFormatter(logging.Formatter):
 def setup_logging(level: str, fmt: str = "text") -> None:
     """Root-logger setup shared by the exporter and aggregator CLIs.
 
-    Unknown ``fmt`` raises instead of silently degrading to text: an
-    operator who set TPE_LOG_FORMAT=JSONL must find out at startup, not
-    when Cloud Logging keeps showing unparsed blobs mid-incident."""
-    lvl = getattr(logging, level.upper(), logging.INFO)
+    Unknown ``fmt`` or ``level`` raises instead of silently degrading
+    (to text / to INFO): an operator who set TPE_LOG_FORMAT=JSONL or
+    --log-level=verbose must find out at startup, not mid-incident when
+    the logs aren't what they configured."""
+    lvl = getattr(logging, level.upper(), None)
+    # `not lvl` also rejects NOTSET (0), whose effective root level is
+    # WARNING — accepting it would silently drop debug/info, the exact
+    # misconfiguration this fail-loud contract exists to prevent.
+    if not isinstance(lvl, int) or not lvl:
+        raise ValueError(
+            "--log-level must be one of debug/info/warning/error/critical, "
+            f"got {level!r}"
+        )
     fmt = fmt.lower()
     if fmt == "json":
         handler = logging.StreamHandler()
