@@ -1329,3 +1329,110 @@ class TestLayoutParser:
             parse_exposition_layout(
                 'm{a="1"} 5 m{a="2"} 6\n', self.NAMES, layout
             )
+
+
+class TestRoundRecordReplay:
+    """RoundRecorder/ReplayFetch — the aggregator twin of the exporter's
+    record/replay backend (SURVEY §5 checkpoint/resume): capture a live
+    incident's fetched bodies, replay them deterministically offline."""
+
+    def _roll(self, tmp_path, rounds):
+        """Record `rounds` (list of {target: body-or-None}) and return the
+        recording path."""
+        from tpu_pod_exporter.aggregate import RoundRecorder
+
+        path = str(tmp_path / "incident.jsonl")
+        rec = RoundRecorder(path, wallclock=lambda: 123.0)
+        for bodies in rounds:
+            rec.record([(t, b, 0.01) for t, b in bodies.items()])
+        rec.close()
+        return path
+
+    def test_replay_reproduces_rollups_and_outage(self, tmp_path):
+        from tpu_pod_exporter.aggregate import ReplayFetch, SliceAggregator
+
+        b0 = make_host_text(0)
+        b1 = make_host_text(1)
+        path = self._roll(tmp_path, [
+            {"h0:8000": b0, "h1:8000": b1},
+            {"h0:8000": b0, "h1:8000": None},   # h1 down in round 2
+        ])
+        fetch = ReplayFetch(path, loop=False)
+        assert fetch.targets == ("h0:8000", "h1:8000")
+        store = SnapshotStore()
+        agg = SliceAggregator(fetch.targets, store, fetch=fetch)
+        try:
+            key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+            agg.poll_once()
+            snap = store.current()
+            assert snap.value("tpu_slice_hosts_reporting", key) == 2.0
+            assert snap.value(
+                "tpu_aggregator_target_up", {"target": "h1:8000"}
+            ) == 1.0
+            agg.poll_once()  # the outage round replays as an outage
+            snap = store.current()
+            assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
+            assert snap.value(
+                "tpu_aggregator_target_up", {"target": "h1:8000"}
+            ) == 0.0
+        finally:
+            agg.close()
+
+    def test_replay_loops_by_default_and_exhausts_without(self, tmp_path):
+        import pytest as _pytest
+
+        from tpu_pod_exporter.aggregate import ReplayFetch
+
+        path = self._roll(tmp_path, [{"h0:8000": "m 1\n"}])
+        looped = ReplayFetch(path)
+        for _ in range(3):  # 1-round recording served 3 times
+            assert looped("h0:8000", 1.0) == "m 1\n"
+        strict = ReplayFetch(path, loop=False)
+        assert strict("h0:8000", 1.0) == "m 1\n"
+        with _pytest.raises(ConnectionError, match="exhausted"):
+            strict("h0:8000", 1.0)
+
+    def test_corrupt_recording_names_path_and_line(self, tmp_path):
+        import pytest as _pytest
+
+        from tpu_pod_exporter.aggregate import ReplayFetch
+
+        p = tmp_path / "bad.jsonl"
+        p.write_text('{"t": 1}\n')  # no "bodies"
+        with _pytest.raises(ValueError, match="bad.jsonl:1"):
+            ReplayFetch(str(p))
+        p.write_text("")
+        with _pytest.raises(ValueError, match="no rounds"):
+            ReplayFetch(str(p))
+
+    def test_record_during_live_rounds_then_replay_matches(self, tmp_path):
+        """End-to-end symmetry: rollups from a live (StaticFetch) run and
+        from replaying its recording are numerically identical."""
+        from tpu_pod_exporter.aggregate import (
+            ReplayFetch,
+            RoundRecorder,
+            SliceAggregator,
+        )
+
+        pages = {"h0:8000": make_host_text(0), "h1:8000": make_host_text(1)}
+        path = str(tmp_path / "cap.jsonl")
+        store_live = SnapshotStore()
+        agg = SliceAggregator(
+            tuple(pages), store_live, fetch=StaticFetch(pages),
+            recorder=RoundRecorder(path),
+        )
+        agg.poll_once()
+        agg.close()
+        store_replay = SnapshotStore()
+        agg2 = SliceAggregator(
+            tuple(pages), store_replay, fetch=ReplayFetch(path)
+        )
+        agg2.poll_once()
+        agg2.close()
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        for name in ("tpu_slice_chip_count", "tpu_slice_hbm_used_bytes",
+                     "tpu_slice_hosts_reporting"):
+            assert (
+                store_live.current().value(name, key)
+                == store_replay.current().value(name, key)
+            ), name

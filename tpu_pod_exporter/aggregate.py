@@ -21,6 +21,7 @@ Run: ``python -m tpu_pod_exporter.aggregate --targets h0:8000,h1:8000``.
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import signal
 import threading
@@ -148,6 +149,91 @@ class _WorkloadAgg:
         self.hosts: set[str] = set()
 
 
+class RoundRecorder:
+    """Append every round's fetched bodies to a JSONL file — the
+    aggregator-side twin of the exporter's record/replay backend
+    (``backend/recorded.py``): capture a live incident (a slice-wide
+    rollup anomaly, a flapping target) once, replay it deterministically
+    offline with :class:`ReplayFetch`. One line per round:
+    ``{"t": epoch, "bodies": {target: text-or-null}, "durations": {...}}``
+    — null marks a target that was down that round, so the replay
+    reproduces outages too. Size note: a 256-chip body is ~950 KB, so an
+    N-target capture grows ~N MB/round; record incidents, not weeks."""
+
+    def __init__(self, path: str, wallclock=time.time) -> None:
+        self._f = open(path, "a", encoding="utf-8")
+        self._wallclock = wallclock
+
+    def record(self, results) -> None:
+        rec = {
+            "t": self._wallclock(),
+            "bodies": {t: text for t, text, _d in results},
+            "durations": {t: d for t, _text, d in results},
+        }
+        self._f.write(json.dumps(rec) + "\n")
+        self._f.flush()  # an incident capture must survive a crash/kill
+
+    def close(self) -> None:
+        try:
+            self._f.close()
+        except Exception:  # noqa: BLE001
+            pass
+
+
+class ReplayFetch:
+    """Serve recorded bodies in round order — inject as ``fetch``.
+
+    Thread-safe for the aggregator's one-call-per-target-per-round pool:
+    a second request for an already-served target advances to the next
+    round. A target recorded as null raises (the round's outage replays
+    as an outage); past the last round, ``loop=True`` (the
+    RecordedBackend convention) starts over, else every fetch raises."""
+
+    def __init__(self, path: str, loop: bool = True) -> None:
+        self._rounds: list[dict] = []
+        with open(path, encoding="utf-8") as f:
+            for i, line in enumerate(f, 1):
+                if not line.strip():
+                    continue
+                try:
+                    rec = json.loads(line)
+                    bodies = rec["bodies"]
+                    if not isinstance(bodies, dict):
+                        raise TypeError(
+                            f"bodies must be an object, got {type(bodies).__name__}"
+                        )
+                except (ValueError, KeyError, TypeError) as e:
+                    raise ValueError(f"{path}:{i}: bad round record: {e}") from e
+                self._rounds.append(bodies)
+        if not self._rounds:
+            raise ValueError(f"{path}: no rounds recorded")
+        self._loop = loop
+        self._idx = 0
+        self._served: set[str] = set()
+        self._lock = threading.Lock()
+
+    @property
+    def targets(self) -> tuple[str, ...]:
+        """Target set of the first round (JSON preserves recording order)."""
+        return tuple(self._rounds[0])
+
+    def __call__(self, target: str, timeout_s: float) -> str:
+        with self._lock:
+            if target in self._served:
+                self._idx += 1
+                self._served = set()
+            if self._idx >= len(self._rounds):
+                if not self._loop:
+                    raise ConnectionError("replay exhausted")
+                self._idx = 0
+            bodies = self._rounds[self._idx]
+            self._served.add(target)
+        body = bodies.get(target)
+        if body is None:
+            raise ConnectionError(f"{target} recorded as down this round")
+        return body
+
+
 class SliceAggregator:
     """Scrape N per-host exporters, publish slice/workload rollups.
 
@@ -163,10 +249,12 @@ class SliceAggregator:
         timeout_s: float = 2.0,
         fetch=default_fetch,
         wallclock=time.time,
+        recorder: "RoundRecorder | None" = None,
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
         self._targets = targets
+        self._recorder = recorder
         self._store = store
         self._timeout_s = timeout_s
         self._fetch = fetch
@@ -197,6 +285,11 @@ class SliceAggregator:
         results = list(
             self._pool.map(self._scrape_one, self._targets)
         )  # [(target, text|None, duration_s)]
+        if self._recorder is not None:
+            try:
+                self._recorder.record(results)
+            except Exception as e:  # noqa: BLE001 — capture must not kill rounds
+                self._rlog.warning("recorder", "round record failed: %s", e)
         self._publish(results, round_started=t0)
 
     def _scrape_one(self, target: str) -> tuple[str, str | None, float]:
@@ -520,12 +613,34 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--log-level", default="info")
     p.add_argument("--log-format", default="text", choices=("text", "json"),
                    help="json = one Cloud-Logging-shaped object per line")
+    p.add_argument("--record-to", default="",
+                   help="append every round's fetched bodies to this JSONL "
+                        "file (incident capture; ~1 MB/target/round)")
+    p.add_argument("--replay-from", default="",
+                   help="serve recorded rounds instead of scraping HTTP "
+                        "(loops at end); with --targets '-', targets come "
+                        "from the recording")
     ns = p.parse_args(argv)
     utils.setup_logging(ns.log_level, ns.log_format)
 
-    targets = tuple(t.strip() for t in ns.targets.split(",") if t.strip())
+    fetch = default_fetch
+    if ns.replay_from:
+        fetch = ReplayFetch(ns.replay_from)
+    elif ns.targets.strip() == "-":
+        p.error("--targets - (targets from recording) requires --replay-from")
+    recorder = RoundRecorder(ns.record_to) if ns.record_to else None
+    # Dedup, order-preserved: a doubled target would fold its chips into
+    # the rollups twice on the live path and corrupt ReplayFetch's
+    # advance-on-repeat round tracking on the replay path.
+    targets = tuple(dict.fromkeys(
+        t.strip() for t in ns.targets.split(",") if t.strip()
+    ))
+    if ns.replay_from and targets == ("-",):
+        targets = fetch.targets
     store = SnapshotStore()
-    agg = SliceAggregator(targets, store, timeout_s=ns.timeout_s)
+    agg = SliceAggregator(
+        targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder
+    )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
@@ -552,6 +667,8 @@ def main(argv: list[str] | None = None) -> int:
     loop.stop()
     server.stop()
     agg.close()
+    if recorder is not None:
+        recorder.close()
     return 0
 
 
