@@ -142,3 +142,69 @@ class TestChurn:
                 if key in last:
                     assert s.value >= last[key]
                 last[key] = s.value
+
+
+class TestParseCacheChurnBounds:
+    """The round-5 parse-path caches under sustained worst-case churn:
+    label VALUES change every round (fresh pod names — the string memo's
+    worst case) while one target flaps across the layout-cache cap. A
+    5-minute live soak (12.5k rounds) showed flat RSS; this fast version
+    pins the bounded-invariant behavior that makes that true."""
+
+    def test_caches_stay_bounded_and_rollups_stay_exact(self, monkeypatch):
+        from tests.test_aggregate import make_host_text
+
+        import tpu_pod_exporter.metrics.parse as parse_mod
+        from tpu_pod_exporter.aggregate import SliceAggregator
+        from tpu_pod_exporter.metrics import SnapshotStore
+
+        # Shrink the global cache caps so 200 churn rounds actually CROSS
+        # them (at production caps this workload never would, making the
+        # closing asserts vacuous — code-review r5): every wholesale-clear
+        # path runs many times during the loop, and correctness of the
+        # rollups is asserted every round on top of it.
+        monkeypatch.setattr(parse_mod, "_STR_MEMO_MAX", 64)
+        monkeypatch.setattr(parse_mod, "_BLOCK_CACHE_MAX_BYTES", 4000)
+        parse_mod._STR_MEMO.clear()
+
+        base = make_host_text(0, chips=8)
+
+        class ChurnFetch:
+            round = 0
+
+            def __call__(self, target, timeout_s):
+                body = base.replace(
+                    'pod="llm-train-0"', f'pod="job-{self.round}"'
+                )
+                if target == "flap:8000" and self.round % 2:
+                    body = body * 3  # over the cap
+                return body
+
+        fetch = ChurnFetch()
+        store = SnapshotStore()
+        agg = SliceAggregator(("h0:8000", "flap:8000"), store, fetch=fetch)
+        flap_layout = agg._parse_layouts["flap:8000"]
+        for lo in agg._parse_layouts.values():
+            lo.max_entries = base.count("\n") + 10
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        try:
+            for r in range(200):
+                fetch.round = r
+                agg.poll_once()
+                snap = store.current()
+                # Rollups exact every round regardless of which parse path
+                # (cached / uncached / re-cached) served each target:
+                # h0 contributes 8 chips; flap contributes 8, or 24 when
+                # its body is tripled (duplicate rows fold per-sample).
+                expect = 8.0 + (24.0 if r % 2 else 8.0)
+                assert snap.value("tpu_slice_chip_count", key) == expect, r
+                assert flap_layout.oversize_logged == bool(r % 2), r
+            fetch.round = 200  # one final under-cap round: flap re-caches
+            agg.poll_once()
+        finally:
+            agg.close()
+        # Bounded invariants that keep long-run RSS flat — non-vacuous
+        # because the shrunken caps above were crossed repeatedly:
+        assert len(parse_mod._STR_MEMO) <= parse_mod._STR_MEMO_MAX
+        assert parse_mod._block_cache_bytes <= parse_mod._BLOCK_CACHE_MAX_BYTES
+        assert flap_layout.entries and not flap_layout.oversize_logged  # re-cached
