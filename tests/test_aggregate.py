@@ -653,6 +653,32 @@ class TestAggregatorDebugVars:
         assert dv["layout_entries"]["down:8000"] == 0  # never reachable
         assert dv["layout_oversize"] == {"h0:8000": False, "down:8000": False}
 
+    def test_aggregator_publishes_loop_overruns(self):
+        # Same contract as tpu_exporter_poll_overruns_total: the one
+        # signal that says --interval-s is too tight for the round cost.
+        pages = {"h0:8000": make_host_text(0)}
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000",), store, fetch=StaticFetch(pages),
+            loop_overruns_fn=lambda: 3,
+        )
+        agg.poll_once()
+        agg.close()
+        assert store.current().value(
+            "tpu_aggregator_poll_overruns_total", {}
+        ) == 3.0
+        # And absent (not zero-faked... zero IS the honest value here, but
+        # the series must not exist at all when no loop is attached).
+        store2 = SnapshotStore()
+        agg2 = SliceAggregator(
+            ("h0:8000",), store2, fetch=StaticFetch(pages)
+        )
+        agg2.poll_once()
+        agg2.close()
+        assert store2.current().value(
+            "tpu_aggregator_poll_overruns_total", {}
+        ) is None
+
     def test_aggregator_publishes_own_cpu_and_rss(self):
         # Same auditability contract as the exporter's self-metrics: the
         # aggregator's slice-scale cost budget (BASELINE.md) must be

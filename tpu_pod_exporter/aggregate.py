@@ -250,11 +250,13 @@ class SliceAggregator:
         fetch=default_fetch,
         wallclock=time.time,
         recorder: "RoundRecorder | None" = None,
+        loop_overruns_fn=None,  # () -> int, from the CollectorLoop
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
         self._targets = targets
         self._recorder = recorder
+        self._loop_overruns_fn = loop_overruns_fn
         self._store = store
         self._timeout_s = timeout_s
         self._fetch = fetch
@@ -447,6 +449,14 @@ class SliceAggregator:
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
+        if self._loop_overruns_fn is not None:
+            try:
+                b.add(
+                    schema.TPU_AGG_POLL_OVERRUNS_TOTAL,
+                    float(self._loop_overruns_fn()),
+                )
+            except Exception:  # noqa: BLE001 — accounting must never fail a round
+                pass
         # Self-resource accounting, same contract as the exporter's series:
         # absent beats fake-zero when the platform can't report a value.
         cpu_s = utils.process_cpu_seconds()
@@ -639,7 +649,11 @@ def main(argv: list[str] | None = None) -> int:
         targets = fetch.targets
     store = SnapshotStore()
     agg = SliceAggregator(
-        targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder
+        targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder,
+        # Late-bound closure (the loop is constructed just below; the
+        # exporter wires its collector the same way, app.py): overruns
+        # surface as tpu_aggregator_poll_overruns_total.
+        loop_overruns_fn=lambda: loop.overruns,
     )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(

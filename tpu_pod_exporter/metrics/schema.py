@@ -533,6 +533,12 @@ TPU_AGG_ROUND_DURATION_SECONDS = MetricSpec(
 # tpu_exporter_cpu_seconds_total / _rss_bytes: the aggregator's own cost
 # at slice scale (BASELINE.md 64x256 budget) must be auditable from its
 # exposition alone.
+TPU_AGG_POLL_OVERRUNS_TOTAL = MetricSpec(
+    name="tpu_aggregator_poll_overruns_total",
+    help="Aggregation rounds skipped because the previous round overran the interval (same contract as tpu_exporter_poll_overruns_total: nonzero rate means --interval-s is too tight for the target count/latency).",
+    type=COUNTER,
+)
+
 TPU_AGG_CPU_SECONDS_TOTAL = MetricSpec(
     name="tpu_aggregator_cpu_seconds_total",
     help="Total user+system CPU time consumed by the aggregator process.",
@@ -584,6 +590,7 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_SCRAPE_ERRORS_TOTAL,
     TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS,
     TPU_AGG_ROUND_DURATION_SECONDS,
+    TPU_AGG_POLL_OVERRUNS_TOTAL,
     TPU_AGG_CPU_SECONDS_TOTAL,
     TPU_AGG_RSS_BYTES,
 )
