@@ -43,6 +43,10 @@ from tpu_pod_exporter.metrics.parse import (
     parse_exposition_layout,
 )
 
+from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter import utils
+from tpu_pod_exporter.utils import RateLimitedLogger
+
 # The only sample names _consume folds. Passed to parse_exposition as a
 # pre-parse filter: a 256-chip body is ~4k lines of which roughly half
 # (per-link counters, percents, info/self series) are irrelevant here —
@@ -59,9 +63,6 @@ CONSUMED_NAMES = frozenset({
     "tpu_pod_chip_count",
     "tpu_pod_hbm_used_bytes",
 })
-from tpu_pod_exporter.server import MetricsServer
-from tpu_pod_exporter import utils
-from tpu_pod_exporter.utils import RateLimitedLogger
 
 log = logging.getLogger("tpu_pod_exporter.aggregate")
 
