@@ -1,8 +1,14 @@
 # Developer entry points.
-.PHONY: test native proto bench clean
+.PHONY: test native proto bench history-demo clean
 
 test:
 	python -m pytest tests/ -q
+
+# Replay the round-5 real-hardware trace through the history flight
+# recorder and print what /api/v1/window_stats would answer — the offline
+# forensics path (deploy/RUNBOOK.md "Forensics after an incident").
+history-demo:
+	python -m tpu_pod_exporter.history --replay tests/fixtures/real-trace-r5.jsonl
 
 native:
 	$(MAKE) -C native

@@ -67,6 +67,21 @@ CONSUMED_NAMES = frozenset({
 log = logging.getLogger("tpu_pod_exporter.aggregate")
 
 
+def target_base_url(target: str) -> str:
+    """``host:port`` (or a full /metrics URL) → the exporter's URL root,
+    for the ``/api/v1/*`` history endpoints."""
+    if target.startswith(("http://", "https://")):
+        return target[: -len("/metrics")] if target.endswith("/metrics") else target
+    return f"http://{target}"
+
+
+def default_history_fetch(url: str, timeout_s: float) -> dict:
+    """GET one history-API URL, parsed JSON. Raises on HTTP/parse failure
+    (the caller treats any raise as 'no history answer from this target')."""
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied targets
+        return json.loads(resp.read().decode("utf-8", errors="replace"))
+
+
 def default_fetch(target: str, timeout_s: float) -> str:
     """``host:port`` (or full URL) → exposition text.
 
@@ -252,6 +267,8 @@ class SliceAggregator:
         wallclock=time.time,
         recorder: "RoundRecorder | None" = None,
         loop_overruns_fn=None,  # () -> int, from the CollectorLoop
+        history_fallback_window_s: float = 0.0,
+        history_fetch=default_history_fetch,
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
@@ -261,6 +278,15 @@ class SliceAggregator:
         self._store = store
         self._timeout_s = timeout_s
         self._fetch = fetch
+        # Missed-round continuity (0 disables): when a target's full scrape
+        # fails, query its history flight recorder (/api/v1/window_stats)
+        # for last-known chip data over this trailing window, so one dropped
+        # round doesn't read as "half the slice vanished". The target still
+        # reports down (target_up=0) — continuity is labeled, not hidden —
+        # and the substitution is counted per target in
+        # tpu_aggregator_history_fallbacks_total.
+        self._history_window_s = history_fallback_window_s
+        self._history_fetch = history_fetch
         self._wallclock = wallclock
         self._counters = CounterStore()
         self._rlog = RateLimitedLogger(log)
@@ -293,7 +319,81 @@ class SliceAggregator:
                 self._recorder.record(results)
             except Exception as e:  # noqa: BLE001 — capture must not kill rounds
                 self._rlog.warning("recorder", "round record failed: %s", e)
-        self._publish(results, round_started=t0)
+        fallbacks: dict[str, list] = {}
+        if self._history_window_s > 0:
+            failed = [t for t, text, _d in results if text is None]
+            if failed:
+                for target, samples in zip(
+                    failed, self._pool.map(self._history_fallback, failed)
+                ):
+                    if samples:
+                        fallbacks[target] = samples
+        self._publish(results, fallbacks=fallbacks, round_started=t0)
+
+    def _history_fallback(self, target: str) -> list | None:
+        """Last-known chip data from a down target's flight recorder, as
+        synthesized ``(name, labels, value)`` samples `_consume` understands.
+
+        Gauges contribute their window-``last`` value; the ICI/DCN byte
+        counters contribute their counter-aware window ``rate`` under the
+        corresponding bandwidth-gauge name — the same quantity a live round
+        would have folded. Any endpoint failure (exporter fully down, no
+        history, pre-history version) returns None and the round proceeds
+        exactly as before the fallback existed."""
+        base = target_base_url(target)
+        window = self._history_window_s
+        samples: list[tuple[str, dict, float]] = []
+        for metric, synth_name, use_rate in (
+            ("tpu_chip_info", "tpu_chip_info", False),
+            ("tpu_hbm_used_bytes", "tpu_hbm_used_bytes", False),
+            ("tpu_hbm_total_bytes", "tpu_hbm_total_bytes", False),
+            ("tpu_tensorcore_duty_cycle_percent",
+             "tpu_tensorcore_duty_cycle_percent", False),
+            # Pod rollups ride along so workload continuity matches slice
+            # continuity — a missed round must not read as "the workload
+            # shrank" while the slice sums hold steady.
+            ("tpu_pod_chip_count", "tpu_pod_chip_count", False),
+            ("tpu_pod_hbm_used_bytes", "tpu_pod_hbm_used_bytes", False),
+            ("tpu_ici_transferred_bytes_total",
+             "tpu_ici_link_bandwidth_bytes_per_second", True),
+            ("tpu_dcn_transferred_bytes_total",
+             "tpu_dcn_link_bandwidth_bytes_per_second", True),
+        ):
+            url = f"{base}/api/v1/window_stats?metric={metric}&window={window:g}"
+            try:
+                doc = self._history_fetch(url, self._timeout_s)
+                rows = doc["data"]["result"]
+            except urllib.error.HTTPError as e:
+                # The endpoint ANSWERED: 404 here just means that family
+                # has no samples (or a pre-history exporter) — cheap, keep
+                # trying the remaining metrics; partial history beats none.
+                self._rlog.info(
+                    f"history:{target}:{metric}",
+                    "history fallback for %s/%s unavailable: %s",
+                    target, metric, e,
+                )
+                continue
+            except Exception as e:  # noqa: BLE001
+                # Connection-level failure (refused, black-holed, timeout):
+                # the remaining metrics would each burn another timeout_s
+                # in the scrape pool — against a black-holed target that is
+                # 6x timeout per round, exactly in the outage the fallback
+                # serves. One strike and out.
+                self._rlog.info(
+                    f"history:{target}",
+                    "history fallback for %s aborted: %s", target, e,
+                )
+                break
+            for row in rows:
+                try:
+                    labels = row["labels"]
+                    value = row["stats"]["rate"] if use_rate else row["stats"]["last"]
+                    if value is None:
+                        continue
+                    samples.append((synth_name, labels, float(value)))
+                except (KeyError, TypeError, ValueError):
+                    continue
+        return samples or None
 
     def _scrape_one(self, target: str) -> tuple[str, str | None, float]:
         t0 = time.monotonic()
@@ -306,10 +406,12 @@ class SliceAggregator:
 
     # ---------------------------------------------------------------- publish
 
-    def _publish(self, results, round_started: float | None = None) -> None:
+    def _publish(self, results, fallbacks=None,
+                 round_started: float | None = None) -> None:
         b = SnapshotBuilder()
         for spec in schema.AGGREGATE_SPECS:
             b.declare(spec)
+        fallbacks = fallbacks or {}
 
         slices: dict[tuple[str, str], _SliceAgg] = {}
         workloads: dict[tuple[str, str, str], _WorkloadAgg] = {}
@@ -339,6 +441,16 @@ class SliceAggregator:
                 self._counters.inc(
                     schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name, (target,)
                 )
+                fb = fallbacks.get(target)
+                if fb:
+                    # Missed-round continuity: the target's flight recorder
+                    # answered even though its full scrape didn't; fold its
+                    # last-known samples so slice chips/hosts/HBM stay
+                    # continuous. target_up stays 0 — the round WAS missed.
+                    self._consume(fb, slices, workloads, slice_groups)
+                    self._counters.inc(
+                        schema.TPU_AGG_HISTORY_FALLBACKS_TOTAL.name, (target,)
+                    )
             b.add(schema.TPU_AGG_TARGET_UP, 1.0 if ok else 0.0, (target,))
             b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
             if text is not None:
@@ -449,6 +561,10 @@ class SliceAggregator:
 
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
+        for lv, v in self._counters.items_for(
+            schema.TPU_AGG_HISTORY_FALLBACKS_TOTAL.name
+        ):
+            b.add(schema.TPU_AGG_HISTORY_FALLBACKS_TOTAL, v, lv)
         b.add(schema.TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS, self._wallclock())
         if self._loop_overruns_fn is not None:
             try:
@@ -621,6 +737,15 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout-s", type=float, default=2.0)
     p.add_argument("--max-scrapes-per-s", type=float, default=100.0,
                    help="rate-cap own /metrics (token bucket; 0 disables)")
+    p.add_argument("--debug-addr", default="127.0.0.1",
+                   help="/debug/* exposure: loopback clients only by "
+                        "default; 0.0.0.0 serves them to any client "
+                        "(same policy as the exporter's --debug-addr)")
+    p.add_argument("--history-fallback-window", type=float, default=0.0,
+                   help="when a target's scrape fails, query its history "
+                        "flight recorder (/api/v1/window_stats) over this "
+                        "trailing window and fold the last-known chip data "
+                        "into the rollups (0 disables; try 3x --interval-s)")
     p.add_argument("--log-level", default="info")
     p.add_argument("--log-format", default="text", choices=("text", "json"),
                    help="json = one Cloud-Logging-shaped object per line")
@@ -655,6 +780,7 @@ def main(argv: list[str] | None = None) -> int:
         # exporter wires its collector the same way, app.py): overruns
         # surface as tpu_aggregator_poll_overruns_total.
         loop_overruns_fn=lambda: loop.overruns,
+        history_fallback_window_s=ns.history_fallback_window,
     )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
@@ -662,6 +788,7 @@ def main(argv: list[str] | None = None) -> int:
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         max_scrapes_per_s=ns.max_scrapes_per_s,
         debug_vars=agg.debug_vars,
+        debug_addr=ns.debug_addr,
     )
 
     stop = threading.Event()

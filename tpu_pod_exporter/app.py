@@ -197,6 +197,24 @@ class ExporterApp:
                 full_scan_every=cfg.process_full_scan_every,
             )
         self.process_scanner = scanner
+        # Flight-recorder history (--history-retention-s 0 disables): ring
+        # capacity is one sample per poll over the retention window, capped
+        # so a sub-second interval cannot balloon the preallocation. Hard
+        # memory bound: max_series x capacity x 24 bytes, allocated only
+        # for series actually present (~32 MB at 256 chips; ceiling ~59 MB
+        # at the 300 s / 1 s / 8192-series defaults).
+        self.history = None
+        if cfg.history_retention_s > 0:
+            from tpu_pod_exporter.history import HistoryStore
+
+            capacity = max(
+                2, min(int(cfg.history_retention_s / cfg.interval_s) + 1, 4096)
+            )
+            self.history = HistoryStore(
+                capacity=capacity,
+                max_series=cfg.history_max_series,
+                retention_s=cfg.history_retention_s,
+            )
         # Scrape-latency distribution: handler threads observe, the
         # collector emits it into each snapshot (one poll behind, which is
         # fine for a cumulative histogram).
@@ -215,6 +233,7 @@ class ExporterApp:
             scrape_rejects_fn=lambda: dict(self.server.scrape_rejects),
             loop_overruns_fn=lambda: self.loop.overruns,
             scrape_duration_hist=scrape_hist,
+            history=self.history,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -229,6 +248,8 @@ class ExporterApp:
             max_concurrent_scrapes=cfg.max_concurrent_scrapes,
             max_scrapes_per_s=cfg.max_scrapes_per_s,
             scrape_observer=scrape_hist.observe,
+            history=self.history,
+            debug_addr=cfg.debug_addr,
         )
 
     def _debug_vars(self) -> dict:
@@ -269,6 +290,8 @@ class ExporterApp:
                 "full_scans": self.process_scanner.full_scans,
                 "verify_scans": self.process_scanner.verify_scans,
             }
+        if self.history is not None:
+            out["history"] = self.history.stats()
         return out
 
     @property

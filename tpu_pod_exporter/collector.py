@@ -80,6 +80,7 @@ class Collector:
         scrape_rejects_fn=None,  # () -> {cause: int}, from the HTTP guard
         loop_overruns_fn=None,   # () -> int, from the CollectorLoop
         scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
+        history=None,  # HistoryStore fed after each snapshot swap
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
@@ -106,6 +107,12 @@ class Collector:
             schema.TPU_EXPORTER_POLL_PHASE_DURATION_HIST
         )
         self._scrape_hist = scrape_duration_hist
+        # Flight recorder: fed once per poll AFTER the snapshot swap, so the
+        # scrape path never contends on the history lock. The append
+        # duration lands in the next snapshot (one poll behind, like
+        # publish/total timings).
+        self._history = history
+        self._history_append_s = 0.0
         # Poll-phase faults repeat every interval (1 s) while a source is
         # down; rate-limit per fault key so logs show the fault, not 86k
         # lines/day. Per-instance: multiple collectors (tests, bench)
@@ -241,9 +248,9 @@ class Collector:
             ok="device_read" not in errors,
             errors=tuple(errors),
         )
-        self._publish(host_sample, device_owner, stats, now_mono=tj1,
-                      allocatable=allocatable, allocated=allocated,
-                      holders=holders)
+        snap = self._publish(host_sample, device_owner, stats, now_mono=tj1,
+                             allocatable=allocatable, allocated=allocated,
+                             holders=holders)
         tp1 = self._clock()
         stats.publish_s = tp1 - tj1
         stats.total_s = tp1 - t0
@@ -259,6 +266,22 @@ class Collector:
             ("total", stats.total_s),
         ):
             self._phase_hist.observe(dur, (phase,))
+        # History append LAST, outside every phase timing: the snapshot is
+        # already swapped (scrapes serve it; the history lock is never on
+        # the scrape path) and the append must not inflate the publish/total
+        # phase distributions it is separately accounted against
+        # (tpu_exporter_history_append_seconds).
+        if self._history is not None:
+            th0 = self._clock()
+            try:
+                self._history.append_snapshot(snap, now_mono=th0,
+                                              now_wall=snap.timestamp)
+            except Exception as e:  # noqa: BLE001 — recording must not fail a poll
+                self._rlog.error(
+                    "history_append", "history append failed: %s", e,
+                    exc_info=True,
+                )
+            self._history_append_s = self._clock() - th0
         return stats
 
     def _read_attribution(self, errors: list[str]) -> AttributionSnapshot | None:
@@ -587,9 +610,32 @@ class Collector:
         # CounterStore now holds only the node-lifetime self-metric
         # counters, so there is nothing to prune per poll.
 
+        if self._history is not None:
+            # Point-in-time history accounting; reflects the append that ran
+            # after the PREVIOUS swap (this poll's append happens below).
+            hs = self._history.stats()
+            b.add(schema.TPU_EXPORTER_HISTORY_SERIES, float(hs["series"]))
+            b.add(schema.TPU_EXPORTER_HISTORY_SAMPLES, float(hs["samples"]))
+            b.add(
+                schema.TPU_EXPORTER_HISTORY_MEMORY_BYTES,
+                float(hs["memory_bytes"]),
+            )
+            for reason, n in hs["evicted"].items():
+                b.add(
+                    schema.TPU_EXPORTER_HISTORY_EVICTED_SERIES_TOTAL,
+                    float(n),
+                    (reason,),
+                )
+            b.add(
+                schema.TPU_EXPORTER_HISTORY_APPEND_SECONDS,
+                self._history_append_s,
+            )
+
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))
-        self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
+        snap = b.build(timestamp=self._wallclock(), transfer=True)
+        self._store.swap(snap)
+        return snap
 
     # ------------------------------------------------------------- ICI fold
 

@@ -48,6 +48,25 @@ class ExporterConfig:
     # of them must be refused, not served. 100/s is ~20× any sane setup
     # (a few Prometheus replicas + an aggregator at 1 Hz).
     max_scrapes_per_s: float = 100.0
+    # Flight-recorder history (tpu_pod_exporter.history): how far back the
+    # node-local /api/v1/* query endpoints can answer. 0 disables history
+    # entirely (no store, endpoints 404). Per-series ring capacity is
+    # retention / interval (capped at 4096 samples); worst-case memory is
+    # history_max_series x capacity x 24 bytes (~59 MB at defaults, only
+    # if the series cap is actually reached).
+    history_retention_s: float = 300.0
+    # Hard cap on stored series; the least-recently-updated series is
+    # evicted beyond it (tpu_exporter_history_evicted_series_total). Sized
+    # above a 256-chip host's tracked set (~4.4k: 5 per-chip gauges + 2
+    # counters x 6 ICI links + pod rollups) so the worst supported shape
+    # never thrashes; memory is allocated per series actually present
+    # (~32 MB at 256 chips, ~0.6 MB on a v4-8 host).
+    history_max_series: int = 8192
+    # /debug/* exposure: by default debug endpoints only answer loopback
+    # clients (run curl on the node). "0.0.0.0" serves them to any client
+    # (the pre-round-5 behaviour); the metrics/health/api endpoints are
+    # unaffected.
+    debug_addr: str = "127.0.0.1"
     process_metrics: bool = False  # procfs scan: which host pids hold which chips
     proc_root: str = "/proc"       # injectable for tests / sidecar mounts
     process_full_scan_every: int = 10  # polls between full /proc walks

@@ -1,0 +1,540 @@
+"""Node-local telemetry flight recorder — bounded in-memory history.
+
+The exporter's snapshot model deliberately keeps only the *latest* poll:
+stale series are structurally impossible, but so is looking backwards. When
+a pod OOMs or a duty-cycle cliff lands between Prometheus scrapes (or on a
+cluster with no Prometheus at all — the gap ``aggregate.py`` exists to
+fill), the evidence is gone by the next scrape. The reference exporter has
+the same blindness — it overwrites two gauges every 30 s and keeps nothing
+(``main.go:74-157``).
+
+:class:`HistoryStore` turns each node into its own short-horizon TSDB: a
+fixed set of per-series ring buffers (float64 value + monotonic and wall
+timestamps, preallocated ``array('d')`` storage, O(1) append) that the
+collector feeds once per poll *after* the snapshot swap — the scrape path
+never touches the history lock. Memory is hard-bounded twice:
+
+- per series: ``capacity`` samples × 24 bytes (three float64 arrays),
+  allocated once at series creation, never grown;
+- across series: at most ``max_series`` rings; creating one more evicts the
+  least-recently-appended series (churned-away pods age out first) and
+  counts it in ``evicted()['capacity']``. Series idle longer than
+  ``retention_s`` are dropped wholesale (``evicted()['retention']``).
+
+Worst case: ``max_series × capacity × 24`` bytes plus per-series
+bookkeeping, allocated only for series actually present (~32 MB at the
+256-chip shape; the exporter defaults cap at 8192 × 301 × 24 ≈ 59 MB).
+
+Query surface (served by ``server.py`` as ``/api/v1/*`` JSON):
+
+- ``series_list()`` — stored series and their label sets;
+- ``query_range(metric, match, start, end, step)`` — samples by wall-clock
+  range, optionally aligned to a step grid;
+- ``window_stats(metric, match, window_s)`` — min/max/mean/first/last over
+  a trailing window plus a counter-aware ``rate`` using the same monotonic
+  fold-with-reset-tolerance semantics as the collector's ICI/DCN rates
+  (negative deltas — device reset — contribute nothing).
+
+Consumers in-tree: ``status.py --watch`` (per-chip deltas and trend arrows
+instead of discarding prior samples) and ``aggregate.py`` (window-stats
+fallback when a scrape round is missed, so slice continuity survives a
+dropped round).
+
+``python -m tpu_pod_exporter.history --replay trace.jsonl`` replays a
+recorded backend trace through a real collector into a history store and
+prints what the flight recorder would answer — the offline forensics demo
+(``make history-demo``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from array import array
+from typing import Mapping
+
+from tpu_pod_exporter.metrics import schema
+
+# Metric families the collector feeds into history each poll. Info series
+# (tpu_host_info, tpu_exporter_info) and self-metrics are excluded — their
+# history is either constant or recoverable from counters — EXCEPT
+# tpu_chip_info and tpu_exporter_up: chip_info is the guaranteed per-chip
+# presence series (HBM may be unreadable), so "which chips existed at time
+# T" must come from it, and exporter_up is the first question of any
+# incident timeline.
+HISTORY_TRACKED_METRICS: frozenset[str] = frozenset({
+    "tpu_hbm_used_bytes",
+    "tpu_hbm_total_bytes",
+    "tpu_hbm_used_percent",
+    "tpu_hbm_peak_bytes",
+    "tpu_chip_info",
+    "tpu_tensorcore_duty_cycle_percent",
+    "tpu_ici_transferred_bytes_total",
+    "tpu_ici_link_bandwidth_bytes_per_second",
+    "tpu_dcn_transferred_bytes_total",
+    "tpu_dcn_link_bandwidth_bytes_per_second",
+    "tpu_pod_chip_count",
+    "tpu_pod_hbm_used_bytes",
+    "tpu_kubelet_allocatable_chips",
+    "tpu_kubelet_allocated_chips",
+    "tpu_exporter_up",
+})
+
+_SPEC_BY_NAME = {spec.name: spec for spec in schema.ALL_SPECS}
+_COUNTER_METRICS = frozenset(
+    name for name, spec in _SPEC_BY_NAME.items() if spec.type == schema.COUNTER
+)
+
+
+def is_counter_metric(name: str) -> bool:
+    """Counter-aware rate eligibility: schema type wins; unknown names fall
+    back to the Prometheus naming convention."""
+    if name in _SPEC_BY_NAME:
+        return name in _COUNTER_METRICS
+    return name.endswith("_total")
+
+
+class _Series:
+    """One series' identity plus its fixed-capacity ring of
+    (t_mono, t_wall, value) float64 triples.
+
+    Three parallel ``array('d')`` buffers, preallocated at construction —
+    an append is three C-level stores plus index arithmetic, no Python
+    object allocation. Ring state lives directly on the series (no nested
+    ring object): the steady-state append loop in ``append_snapshot`` is
+    the store's hot path at 256-chip scale (~4.4k series/poll) and a
+    per-sample method call there is the dominant cost (measured)."""
+
+    __slots__ = ("name", "labels", "cap", "n", "head", "tm", "tw", "vals",
+                 "last_mono")
+
+    def __init__(self, name: str, labels: dict[str, str], cap: int) -> None:
+        zeros = bytes(8 * cap)
+        self.name = name
+        self.labels = labels
+        self.cap = cap
+        self.n = 0
+        self.head = 0  # next write slot
+        self.tm = array("d", zeros)
+        self.tw = array("d", zeros)
+        self.vals = array("d", zeros)
+        self.last_mono = 0.0
+
+    def append(self, t_mono: float, t_wall: float, value: float) -> None:
+        i = self.head
+        self.tm[i] = t_mono
+        self.tw[i] = t_wall
+        self.vals[i] = value
+        self.head = (i + 1) % self.cap
+        if self.n < self.cap:
+            self.n += 1
+        self.last_mono = t_mono
+
+class HistoryStore:
+    """Bounded multi-series ring-buffer store with a query API.
+
+    Thread contract: ``append*`` is called by the poll thread (one lock
+    acquisition per poll, after the snapshot swap — never on the scrape
+    path); queries come from HTTP handler threads and copy results out
+    under the same lock.
+    """
+
+    def __init__(
+        self,
+        capacity: int = 301,
+        max_series: int = 4096,
+        retention_s: float = 300.0,
+        clock=time.monotonic,
+        wallclock=time.time,
+    ) -> None:
+        if capacity < 2:
+            raise ValueError("history capacity must be >= 2")
+        if max_series < 1:
+            raise ValueError("history max_series must be >= 1")
+        self.capacity = capacity
+        self.max_series = max_series
+        self.retention_s = retention_s
+        self._clock = clock
+        self._wallclock = wallclock
+        self._lock = threading.Lock()
+        # (metric, label values tuple) -> _Series. Eviction picks the
+        # minimum last_mono by scan — O(series) per eviction, but evictions
+        # only happen past max_series, which is sized above the worst
+        # supported host shape.
+        self._series: dict[tuple, _Series] = {}
+        # Steady-state fast path, the history twin of the renderer's
+        # FamilyLayout: when a tracked family's key tuple is identical to
+        # the previous poll (no churn), its _Series objects are replayed
+        # from this cache and appends run as one tight zip loop — no
+        # per-sample dict lookups or method calls. Any eviction clears the
+        # cache wholesale (an entry could otherwise keep feeding a series
+        # that no longer exists in the store).
+        self._layouts: dict[str, tuple[tuple, list[_Series]]] = {}
+        self._samples = 0  # retained samples across all rings
+        self._evicted = {"capacity": 0, "retention": 0}
+        # Bumped on every eviction. The slow path snapshots it before
+        # walking a family and refuses to cache the family's layout if it
+        # changed mid-walk: an eviction can claim a series created earlier
+        # in the same walk, and caching that ghost would let the fast path
+        # feed a series no longer in the store — silently losing samples
+        # while the eviction counter sits still.
+        self._evict_gen = 0
+        # Retention GC is a full-store scan; at one poll per second that
+        # would cost more than the appends it polices. Amortized: scans run
+        # at most every retention/32 (min 1 s), so an idle series lives at
+        # most ~3% past its retention — invisible at query granularity.
+        self._gc_interval_s = max(1.0, retention_s / 32.0)
+        self._last_gc = 0.0
+
+    # ---------------------------------------------------------------- append
+
+    def append(
+        self,
+        metric: str,
+        labels: Mapping[str, str],
+        value: float,
+        t_mono: float | None = None,
+        t_wall: float | None = None,
+    ) -> None:
+        """Record one sample (public single-series entry point — used by
+        ``status --watch``; the collector batches via append_snapshot)."""
+        tm = self._clock() if t_mono is None else t_mono
+        tw = self._wallclock() if t_wall is None else t_wall
+        key = (metric, tuple(sorted(labels.items())))
+        with self._lock:
+            self._append_locked(key, metric, dict(labels), float(value), tm, tw)
+            self._gc_locked(tm)
+
+    def append_snapshot(
+        self, snapshot, now_mono: float, now_wall: float
+    ) -> int:
+        """Feed every tracked family of one collector snapshot; returns the
+        number of samples appended. One lock acquisition for the whole poll.
+
+        Steady state (identical family layout to the previous poll) runs
+        the inlined zip loop over cached _Series objects; any churn falls
+        back to the keyed path for that family and rebuilds its layout."""
+        appended = 0
+        with self._lock:
+            layouts = self._layouts
+            for name in HISTORY_TRACKED_METRICS:
+                spec = _SPEC_BY_NAME.get(name)
+                if spec is None:
+                    continue
+                fam = snapshot.samples(name)
+                if not fam:
+                    continue
+                keys = tuple(fam)
+                cached = layouts.get(name)
+                if cached is not None and cached[0] == keys:
+                    new_samples = 0
+                    for s, v in zip(cached[1], fam.values()):
+                        i = s.head
+                        s.tm[i] = now_mono
+                        s.tw[i] = now_wall
+                        s.vals[i] = v
+                        i += 1
+                        s.head = 0 if i == s.cap else i
+                        if s.n != s.cap:
+                            s.n += 1
+                            new_samples += 1
+                        s.last_mono = now_mono
+                    self._samples += new_samples
+                    appended += len(keys)
+                    continue
+                # Slow path: churn or first sighting — keyed appends, then
+                # freeze this poll's series list as the next poll's layout.
+                label_names = spec.label_names
+                series_list: list[_Series] = []
+                gen0 = self._evict_gen
+                for lvs, value in fam.items():
+                    key = (name, lvs)
+                    s = self._series.get(key)
+                    if s is None:
+                        s = self._create_locked(
+                            key, name, dict(zip(label_names, lvs))
+                        )
+                    if s.n != s.cap:
+                        self._samples += 1
+                    s.append(now_mono, now_wall, value)
+                    series_list.append(s)
+                    appended += 1
+                if self._evict_gen == gen0:
+                    layouts[name] = (keys, series_list)
+                # else: an eviction landed mid-walk and series_list may hold
+                # a ghost — leave the family uncached (next poll re-keys).
+            self._gc_locked(now_mono)
+        return appended
+
+    def _append_locked(
+        self, key: tuple, metric: str, labels: dict[str, str],
+        value: float, tm: float, tw: float,
+    ) -> None:
+        s = self._series.get(key)
+        if s is None:
+            s = self._create_locked(key, metric, labels)
+        if s.n != s.cap:
+            self._samples += 1
+        s.append(tm, tw, value)
+
+    def _create_locked(self, key: tuple, metric: str,
+                       labels: dict[str, str]) -> _Series:
+        while len(self._series) >= self.max_series:
+            victim_key = min(self._series, key=lambda k: self._series[k].last_mono)
+            victim = self._series.pop(victim_key)
+            self._samples -= victim.n
+            self._evicted["capacity"] += 1
+            self._evict_gen += 1
+            self._layouts.clear()  # a layout may still reference the victim
+        s = self._series[key] = _Series(metric, labels, self.capacity)
+        return s
+
+    def _gc_locked(self, now_mono: float) -> None:
+        """Drop series idle past retention (amortized full scan)."""
+        if self.retention_s <= 0:
+            return
+        if now_mono - self._last_gc < self._gc_interval_s:
+            return
+        self._last_gc = now_mono
+        horizon = now_mono - self.retention_s
+        stale = [k for k, s in self._series.items() if s.last_mono < horizon]
+        for k in stale:
+            s = self._series.pop(k)
+            self._samples -= s.n
+            self._evicted["retention"] += 1
+        if stale:
+            self._evict_gen += 1
+            self._layouts.clear()
+
+    # ----------------------------------------------------------------- query
+
+    @staticmethod
+    def _matches(labels: dict[str, str], match: Mapping[str, str]) -> bool:
+        return all(labels.get(k) == v for k, v in match.items())
+
+    def _rows_for(self, metric: str, match: Mapping[str, str]) -> list[tuple]:
+        """Matching series' ring contents, copied out under the lock as raw
+        ``array('d')`` slices — C-speed memcpy, ~7 KB per series. The
+        per-sample Python tuples are built OUTSIDE the lock by
+        ``_row_items``: a match-less query on a 256-chip store materializes
+        ~1.3M tuples, and doing that under the lock would let any client of
+        the (unauthenticated) /api/v1 endpoints starve the poll thread's
+        append and stall polling."""
+        with self._lock:
+            return [
+                (s.labels, s.cap, s.n, s.head, s.tm[:], s.tw[:], s.vals[:])
+                for s in self._series.values()
+                if s.name == metric and self._matches(s.labels, match)
+            ]
+
+    @staticmethod
+    def _row_items(row: tuple) -> list[tuple[float, float, float]]:
+        """One copied row's samples, oldest first, as (t_mono, t_wall, v)."""
+        _labels, cap, n, head, tm, tw, vals = row
+        start = (head - n) % cap
+        return [
+            (tm[i], tw[i], vals[i])
+            for i in ((start + k) % cap for k in range(n))
+        ]
+
+    def series_list(self) -> list[dict]:
+        with self._lock:
+            return [
+                {"metric": s.name, "labels": dict(s.labels),
+                 "samples": s.n}
+                for s in self._series.values()
+            ]
+
+    def query_range(
+        self,
+        metric: str,
+        match: Mapping[str, str] | None = None,
+        start: float | None = None,
+        end: float | None = None,
+        step: float = 0.0,
+    ) -> list[dict]:
+        """Samples of every matching series with wall time in [start, end].
+
+        ``step == 0`` returns raw samples; ``step > 0`` aligns to the grid
+        ``start, start+step, …, end``, each point carrying the most recent
+        sample at or before it (within a ``max(2*step, 10 s)`` staleness
+        lookback, so a long-dead series doesn't project forward forever).
+        """
+        if end is None:
+            end = self._wallclock()
+        if start is None:
+            start = end - 300.0
+        out: list[dict] = []
+        for row in self._rows_for(metric, match or {}):
+            labels = row[0]
+            items = self._row_items(row)
+            if step > 0:
+                # Grid alignment carries the most recent sample at or
+                # before each point, so samples just BEFORE `start` are
+                # still eligible for the left-edge grid points (within the
+                # lookback) — filtering them out would fake a gap at the
+                # start of an incident window.
+                raw = [(tw, v) for (_tm, tw, v) in items if tw <= end]
+                lookback = max(2.0 * step, 10.0)
+                aligned: list[list[float]] = []
+                i = -1
+                t = start
+                # one forward pointer walk: raw is time-ordered
+                while t <= end + 1e-9:
+                    while i + 1 < len(raw) and raw[i + 1][0] <= t:
+                        i += 1
+                    if i >= 0 and t - raw[i][0] <= lookback:
+                        aligned.append([t, raw[i][1]])
+                    t += step
+                values = aligned
+            else:
+                values = [
+                    [tw, v] for (_tm, tw, v) in items if start <= tw <= end
+                ]
+            if values:
+                out.append(
+                    {"metric": metric, "labels": dict(labels), "values": values}
+                )
+        return out
+
+    def window_stats(
+        self,
+        metric: str,
+        match: Mapping[str, str] | None = None,
+        window_s: float = 60.0,
+        now_mono: float | None = None,
+    ) -> list[dict]:
+        """min/max/mean/first/last over the trailing window, plus a
+        counter-aware ``rate`` (sum of positive deltas / elapsed — the
+        ICI/DCN monotonic-fold semantics: a device reset holds, it never
+        goes negative). ``rate`` is null for gauges and for windows with
+        fewer than two samples."""
+        now = self._clock() if now_mono is None else now_mono
+        lo = now - window_s
+        counter = is_counter_metric(metric)
+        out: list[dict] = []
+        for row in self._rows_for(metric, match or {}):
+            labels = row[0]
+            items = self._row_items(row)
+            win = [(tm, tw, v) for (tm, tw, v) in items if tm >= lo]
+            if not win:
+                continue
+            vals = [v for (_tm, _tw, v) in win]
+            stats = {
+                "min": min(vals),
+                "max": max(vals),
+                "mean": sum(vals) / len(vals),
+                "first": vals[0],
+                "last": vals[-1],
+                "first_t": win[0][1],
+                "last_t": win[-1][1],
+                "samples": len(vals),
+                "rate": None,
+            }
+            if counter and len(win) >= 2:
+                dt = win[-1][0] - win[0][0]
+                if dt > 0:
+                    gained = sum(
+                        d for d in
+                        (b - a for a, b in zip(vals, vals[1:]))
+                        if d > 0
+                    )
+                    stats["rate"] = gained / dt
+            out.append({"metric": metric, "labels": dict(labels), "stats": stats})
+        return out
+
+    # ----------------------------------------------------------- introspection
+
+    def stats(self) -> dict:
+        with self._lock:
+            nseries = len(self._series)
+            return {
+                "series": nseries,
+                "samples": self._samples,
+                "evicted": dict(self._evicted),
+                "capacity": self.capacity,
+                "max_series": self.max_series,
+                "retention_s": self.retention_s,
+                # three float64 arrays per ring, allocated at full capacity
+                "memory_bytes": nseries * self.capacity * 24,
+            }
+
+
+# --------------------------------------------------------------------- demo
+
+
+def main(argv: list[str] | None = None) -> int:
+    """Replay a recorded backend trace through a real collector into a
+    HistoryStore and print the flight recorder's answers — offline incident
+    forensics with zero hardware (``make history-demo``)."""
+    import argparse
+
+    from tpu_pod_exporter.attribution.fake import FakeAttribution
+    from tpu_pod_exporter.backend.recorded import RecordedBackend
+    from tpu_pod_exporter.collector import Collector
+    from tpu_pod_exporter.metrics import SnapshotStore
+
+    p = argparse.ArgumentParser(
+        prog="tpu-pod-exporter-history",
+        description="Replay a recorded trace into the telemetry flight "
+                    "recorder and print window stats.",
+    )
+    p.add_argument("--replay", required=True,
+                   help="JSONL trace recorded with --record-to")
+    p.add_argument("--polls", type=int, default=0,
+                   help="polls to replay (default: one pass over the trace)")
+    p.add_argument("--interval-s", type=float, default=1.0,
+                   help="simulated seconds between replayed polls")
+    p.add_argument("--window-s", type=float, default=0.0,
+                   help="window for stats (default: the whole replay)")
+    ns = p.parse_args(argv)
+
+    backend = RecordedBackend(ns.replay, loop=True)
+    polls = ns.polls or len(backend)
+    window = ns.window_s or polls * ns.interval_s + 1.0
+
+    # Simulated clocks: the replay runs at memory speed but history sees
+    # evenly spaced poll timestamps, so rates/windows mean what they say.
+    sim = {"t": 0.0}
+    base_wall = 1_700_000_000.0
+    history = HistoryStore(
+        capacity=max(2, min(polls + 1, 4096)),
+        retention_s=0.0,  # forensics replay: never age anything out
+        clock=lambda: sim["t"],
+        wallclock=lambda: base_wall + sim["t"],
+    )
+    collector = Collector(
+        backend, FakeAttribution(), SnapshotStore(), history=history,
+        clock=lambda: sim["t"], wallclock=lambda: base_wall + sim["t"],
+    )
+    for i in range(polls):
+        sim["t"] = i * ns.interval_s
+        collector.poll_once()
+
+    st = history.stats()
+    print(f"replayed {polls} polls from {ns.replay}")
+    print(f"history: {st['series']} series, {st['samples']} samples, "
+          f"~{st['memory_bytes'] / 1024:.0f} KiB, evicted={st['evicted']}")
+    metrics = sorted({s["metric"] for s in history.series_list()})
+    if not metrics:
+        print("no tracked series in this trace")
+        return 0
+    for metric in metrics:
+        print(f"\n{metric} (window={window:g}s):")
+        for row in history.window_stats(metric, window_s=window,
+                                        now_mono=sim["t"] + 1e-9):
+            s = row["stats"]
+            ident = ",".join(
+                f"{k}={v}" for k, v in sorted(row["labels"].items()) if v
+            )
+            rate = "" if s["rate"] is None else f" rate={s['rate']:.1f}/s"
+            print(f"  {{{ident}}} n={s['samples']} min={s['min']:g} "
+                  f"max={s['max']:g} mean={s['mean']:g} last={s['last']:g}"
+                  f"{rate}")
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -10,6 +10,7 @@ Examples:
 from __future__ import annotations
 
 import argparse
+import math
 import sys
 import time
 
@@ -101,8 +102,12 @@ def main(argv=None) -> int:
             # (see burn mode); also catches a NaN'd feedback loop early.
             leaf = out[0] if isinstance(out, tuple) else out
             probe = float(jnp.ravel(leaf)[0])
-            if probe != probe:
-                print(f"NaN after {steps} steps", file=sys.stderr)
+            # Divergence check, not just NaN: a feedback loop that blows up
+            # usually passes through ±inf on the way, and `x != x` only
+            # catches NaN — abort on any non-finite probe (advisor r5).
+            if not math.isfinite(probe):
+                print(f"non-finite probe ({probe}) after {steps} steps",
+                      file=sys.stderr)
                 return 1
             steps += 1
         dt = time.monotonic() - t0

@@ -300,6 +300,51 @@ TPU_EXPORTER_INFO = MetricSpec(
     label_names=("version", "backend", "attribution"),
 )
 
+# --- History flight recorder self-metrics (tpu_pod_exporter.history) ---------
+# Emitted only when history is enabled (--history-retention-s > 0), so they
+# live outside ALL_SPECS — same conditional-surface rule as
+# TPU_CHIP_PROCESS_INFO. Size/eviction/append-time must be auditable: the
+# store is hard-bounded, and these say how close to the bound it runs.
+
+TPU_EXPORTER_HISTORY_SERIES = MetricSpec(
+    name="tpu_exporter_history_series",
+    help="Series currently held in the in-memory history store (bounded by --history-max-series).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_HISTORY_SAMPLES = MetricSpec(
+    name="tpu_exporter_history_samples",
+    help="Samples currently retained across all history ring buffers.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_HISTORY_MEMORY_BYTES = MetricSpec(
+    name="tpu_exporter_history_memory_bytes",
+    help="Preallocated ring-buffer bytes held by the history store (series x capacity x 24).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_HISTORY_EVICTED_SERIES_TOTAL = MetricSpec(
+    name="tpu_exporter_history_evicted_series_total",
+    help="History series dropped since start, by reason: 'capacity' (--history-max-series hit; raise it or expect churned series to age out) vs 'retention' (idle past --history-retention-s — normal pod churn).",
+    type=COUNTER,
+    label_names=("reason",),
+)
+
+TPU_EXPORTER_HISTORY_APPEND_SECONDS = MetricSpec(
+    name="tpu_exporter_history_append_seconds",
+    help="Duration of the most recent history append (runs after the snapshot swap, off the scrape path; one poll behind).",
+    type=GAUGE,
+)
+
+HISTORY_SPECS: tuple[MetricSpec, ...] = (
+    TPU_EXPORTER_HISTORY_SERIES,
+    TPU_EXPORTER_HISTORY_SAMPLES,
+    TPU_EXPORTER_HISTORY_MEMORY_BYTES,
+    TPU_EXPORTER_HISTORY_EVICTED_SERIES_TOTAL,
+    TPU_EXPORTER_HISTORY_APPEND_SECONDS,
+)
+
 # --- Legacy migration aliases (off by default; --legacy-metrics) ------------
 # The reference's exact metric names (main.go:24,31) so its dashboards work
 # unchanged during migration. Semantic shift, documented in the help text:
@@ -517,6 +562,13 @@ TPU_AGG_SCRAPE_ERRORS_TOTAL = MetricSpec(
     label_names=("target",),
 )
 
+TPU_AGG_HISTORY_FALLBACKS_TOTAL = MetricSpec(
+    name="tpu_aggregator_history_fallbacks_total",
+    help="Rounds in which a target's full scrape failed but its /api/v1/window_stats history answered, so the host's last-known chip data still contributed to slice rollups (target_up stays 0 for the round).",
+    type=COUNTER,
+    label_names=("target",),
+)
+
 TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS = MetricSpec(
     name="tpu_aggregator_last_round_timestamp_seconds",
     help="Unix timestamp of the most recent completed aggregation round.",
@@ -588,6 +640,7 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_TARGET_UP,
     TPU_AGG_SCRAPE_DURATION_SECONDS,
     TPU_AGG_SCRAPE_ERRORS_TOTAL,
+    TPU_AGG_HISTORY_FALLBACKS_TOTAL,
     TPU_AGG_LAST_ROUND_TIMESTAMP_SECONDS,
     TPU_AGG_ROUND_DURATION_SECONDS,
     TPU_AGG_POLL_OVERRUNS_TOTAL,

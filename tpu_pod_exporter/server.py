@@ -11,6 +11,14 @@ Additional endpoints the reference lacks:
 - ``/healthz`` — liveness (process up, returns 200 always).
 - ``/readyz`` — readiness (200 once at least one poll has completed, 503
   before; lets a DaemonSet rolling update wait for real data).
+- ``/api/v1/series`` / ``/api/v1/query_range`` / ``/api/v1/window_stats`` —
+  JSON queries against the node-local history flight recorder
+  (``tpu_pod_exporter.history``); served on the metrics port because the
+  slice aggregator consumes them. Absent history (``--history-retention-s
+  0``) answers 404 JSON.
+- ``/debug/vars`` and ``/debug/stacks`` answer **loopback clients only** by
+  default (thread stacks and config are operator surface, not fleet
+  surface); ``--debug-addr 0.0.0.0`` restores remote access.
 
 The server is a stdlib ThreadingHTTPServer: no event-loop dependency, a few
 concurrent scrapers at most (Prometheus), and request handling does no
@@ -19,12 +27,40 @@ per-request allocation beyond headers.
 
 from __future__ import annotations
 
+import json
 import logging
+import math
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 from tpu_pod_exporter.metrics import SnapshotStore
+
+
+def _json_sanitize(obj):
+    """Replace non-finite floats with None, recursively (slow path of
+    _serve_json — only runs when a response actually contains one)."""
+    if isinstance(obj, float):
+        return obj if math.isfinite(obj) else None
+    if isinstance(obj, dict):
+        return {k: _json_sanitize(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [_json_sanitize(v) for v in obj]
+    return obj
+
+
+def debug_client_allowed(client_ip: str, debug_addr: str) -> bool:
+    """Whether a /debug/* request from ``client_ip`` may be served.
+
+    Default policy is loopback-only: thread stacks and effective config are
+    operator surface. ``--debug-addr 0.0.0.0`` (or ``*``) opens the debug
+    endpoints to any client that can reach the metrics port. Loopback is
+    always allowed regardless of the setting — the RUNBOOK's on-node curl
+    must never lock itself out."""
+    if client_ip.startswith("127.") or client_ip == "::1" or client_ip.startswith("::ffff:127."):
+        return True
+    return debug_addr in ("0.0.0.0", "*")
 
 
 def _format_stacks() -> str:
@@ -154,6 +190,18 @@ class _Handler(BaseHTTPRequestHandler):
     # set by server factory
     store: SnapshotStore
     debug_vars = None  # optional callable -> dict
+    # Optional HistoryStore serving /api/v1/*; None = history disabled.
+    history = None
+    # Concurrency fence for /api/v1/*: queries copy ring contents (cheap,
+    # but not free at 256-chip scale) and ThreadingHTTPServer spawns a
+    # thread per request — without a cap, a flood of history queries could
+    # keep the store lock contended against the poll thread's append.
+    # Small and separate from the scrape semaphore: the aggregator's
+    # missed-round fallback must not queue behind a scrape storm.
+    api_sem: threading.BoundedSemaphore | None = None
+    api_queue_timeout_s: float = 0.25
+    # /debug/* exposure policy (see debug_client_allowed).
+    debug_addr: str = "127.0.0.1"
     # /healthz fails when the newest snapshot is older than this (0 = never).
     # A poll thread wedged inside a hung device runtime stops swapping
     # snapshots; liveness must catch that so kubelet restarts the pod —
@@ -186,12 +234,21 @@ class _Handler(BaseHTTPRequestHandler):
     protocol_version = "HTTP/1.1"
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
-        path = self.path.split("?", 1)[0]
+        path, _, query = self.path.partition("?")
         if path == "/metrics":
             self._serve_metrics()
+        elif path.startswith("/api/v1/"):
+            self._serve_api(path, query)
+        elif path.startswith("/debug/") and not debug_client_allowed(
+            self.client_address[0], self.debug_addr
+        ):
+            # Loopback-only by default: stacks + effective config are
+            # operator surface. --debug-addr 0.0.0.0 restores remote reads.
+            self._serve_text(
+                403, b"debug endpoints are loopback-only "
+                     b"(start with --debug-addr 0.0.0.0 to expose)\n"
+            )
         elif path == "/debug/vars" and self.debug_vars is not None:
-            import json
-
             try:
                 body = json.dumps(type(self).debug_vars(), indent=1).encode()
             except Exception as e:  # noqa: BLE001 — debug must not 500 loops
@@ -233,10 +290,131 @@ class _Handler(BaseHTTPRequestHandler):
         elif path == "/":
             self._serve_text(
                 200,
-                b"tpu-pod-exporter\n/metrics /healthz /readyz\n",
+                b"tpu-pod-exporter\n/metrics /healthz /readyz "
+                b"/api/v1/series /api/v1/query_range /api/v1/window_stats\n",
             )
         else:
             self._serve_text(404, b"not found\n")
+
+    # ------------------------------------------------------- history queries
+
+    def _serve_api(self, path: str, query: str) -> None:
+        """JSON query surface over the history flight recorder. Outside the
+        scrape fences (the aggregator's missed-round fallback must not
+        compete with the very scrape storm it is working around) but behind
+        its own small concurrency cap."""
+        sem = self.api_sem
+        if sem is not None and not sem.acquire(timeout=self.api_queue_timeout_s):
+            self._serve_json(429, {
+                "status": "error",
+                "error": "too many concurrent history queries",
+            })
+            return
+        try:
+            self._serve_api_inner(path, query)
+        finally:
+            if sem is not None:
+                sem.release()
+
+    def _serve_api_inner(self, path: str, query: str) -> None:
+        h = self.history
+        if h is None:
+            self._serve_json(404, {
+                "status": "error",
+                "error": "history disabled (--history-retention-s 0)",
+            })
+            return
+        qs = parse_qs(query, keep_blank_values=True)
+
+        def param(name: str, default: str | None = None) -> str | None:
+            vals = qs.get(name)
+            return vals[-1] if vals else default
+
+        match = {
+            k[len("match["):-1]: vs[-1]
+            for k, vs in qs.items()
+            if k.startswith("match[") and k.endswith("]") and len(k) > 7
+        }
+        try:
+            if path == "/api/v1/series":
+                self._serve_json(200, {"status": "ok", "data": h.series_list()})
+                return
+            if path == "/api/v1/query_range":
+                metric = param("metric")
+                if not metric:
+                    raise ValueError("missing required parameter: metric")
+                end = float(param("end") or time.time())
+                start = float(param("start") or (end - 300.0))
+                step = float(param("step") or 0.0)
+                # Finite + bounded before the store walks a grid: the grid
+                # loop is O((end-start)/step) Python iterations, and this
+                # endpoint is unauthenticated and exempt from the scrape
+                # fences — start=0&step=1 (~1.7e9 points) or end=inf must
+                # be a 400, not a pinned handler thread. Cap matches
+                # Prometheus's 11k resolution limit.
+                if not (math.isfinite(start) and math.isfinite(end)
+                        and math.isfinite(step)):
+                    raise ValueError("start/end/step must be finite")
+                if step < 0:
+                    raise ValueError("step must be >= 0")
+                if end < start:
+                    raise ValueError("end must be >= start")
+                if step > 0 and (end - start) / step > 11000:
+                    raise ValueError(
+                        "query resolution too high: (end - start) / step "
+                        "must be <= 11000"
+                    )
+                result = h.query_range(metric, match, start, end, step)
+                if not result:
+                    self._serve_json(404, {
+                        "status": "error",
+                        "error": f"no samples for metric {metric!r} "
+                                 f"matching {match!r} in range",
+                    })
+                    return
+                self._serve_json(200, {
+                    "status": "ok",
+                    "data": {"resultType": "matrix", "result": result},
+                })
+                return
+            if path == "/api/v1/window_stats":
+                metric = param("metric")
+                if not metric:
+                    raise ValueError("missing required parameter: metric")
+                window = float(param("window") or 60.0)
+                if window <= 0:
+                    raise ValueError("window must be > 0")
+                result = h.window_stats(metric, match, window_s=window)
+                if not result:
+                    self._serve_json(404, {
+                        "status": "error",
+                        "error": f"no samples for metric {metric!r} "
+                                 f"matching {match!r} in window",
+                    })
+                    return
+                self._serve_json(200, {"status": "ok",
+                                       "data": {"result": result}})
+                return
+        except ValueError as e:
+            self._serve_json(400, {"status": "error", "error": str(e)})
+            return
+        self._serve_json(404, {"status": "error", "error": "unknown API path"})
+
+    def _serve_json(self, code: int, obj) -> None:
+        try:
+            # allow_nan=False: bare NaN/Infinity literals are not JSON and
+            # break every strict parser (jq, JSON.parse, encoding/json) —
+            # exactly during the forensics these endpoints serve. Backends
+            # CAN report NaN samples (format_value supports them), so the
+            # fallback path maps non-finite values to null instead of 500ing.
+            body = json.dumps(obj, allow_nan=False).encode()
+        except ValueError:
+            body = json.dumps(_json_sanitize(obj)).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
 
     def _serve_metrics(self) -> None:
         bucket = self.scrape_bucket
@@ -338,6 +516,8 @@ class MetricsServer:
         max_scrapes_per_s: float = 0.0,
         scrape_tarpit_s: float = 0.1,
         scrape_observer=None,
+        history=None,
+        debug_addr: str = "127.0.0.1",
     ) -> None:
         # Both causes pre-seeded so the self-metric publishes a 0 series
         # per cause from poll 1 (stable surface).
@@ -348,6 +528,11 @@ class MetricsServer:
             {
                 "store": store,
                 "debug_vars": staticmethod(debug_vars) if debug_vars else None,
+                "history": history,
+                "api_sem": (
+                    threading.BoundedSemaphore(2) if history is not None else None
+                ),
+                "debug_addr": debug_addr,
                 "health_max_age_s": health_max_age_s,
                 "scrape_sem": (
                     threading.BoundedSemaphore(max_concurrent_scrapes)

@@ -4,7 +4,10 @@
 exporter daemon uses (same flags/env) and prints a chip table plus per-pod
 rollups. Exits non-zero if the device read fails. ``--process-metrics``
 adds a holder column (host pid/comm per chip, from the procfs scanner);
-``--watch N`` re-renders every N seconds until interrupted.
+``--watch N`` re-renders every N seconds until interrupted, feeding each
+sample into a local :class:`~tpu_pod_exporter.history.HistoryStore` so the
+table shows per-chip HBM/duty deltas and trend arrows over the trailing
+window instead of discarding prior samples.
 """
 
 from __future__ import annotations
@@ -63,6 +66,13 @@ def main(argv=None) -> int:
     try:
         if ns.watch <= 0:
             return _run(cfg, topo, backend, attribution, scanner, as_json=ns.json)
+        # Watch mode keeps a local flight recorder so each render can show
+        # where a value is HEADING, not just where it is. Bounded exactly
+        # like the daemon's store, scaled to one screenful of history.
+        from tpu_pod_exporter.history import HistoryStore
+
+        history = HistoryStore(capacity=256, max_series=2048, retention_s=0.0)
+        trend_window_s = max(10.0 * ns.watch, 5.0)
         while True:
             if ns.json:
                 # JSONL stream: no ANSI escapes, one object per line, so
@@ -72,7 +82,8 @@ def main(argv=None) -> int:
             else:
                 # ANSI home+clear keeps the table in place like `watch`.
                 print("\x1b[H\x1b[2J", end="")
-                rc = _run(cfg, topo, backend, attribution, scanner)
+                rc = _run(cfg, topo, backend, attribution, scanner,
+                          history=history, trend_window_s=trend_window_s)
             if rc != 0:
                 return rc
             time.sleep(ns.watch)
@@ -83,7 +94,27 @@ def main(argv=None) -> int:
         attribution.close()
 
 
-def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
+def trend_cell(history, metric: str, chip_id, window_s: float,
+               fmt, eps: float) -> str:
+    """Delta + direction arrow for one chip's series over the trailing
+    window, from the watch-mode history store. "-" until two samples exist."""
+    rows = history.window_stats(
+        metric, {"chip_id": str(chip_id)}, window_s=window_s
+    )
+    if not rows or rows[0]["stats"]["samples"] < 2:
+        return "-"
+    s = rows[0]["stats"]
+    delta = s["last"] - s["first"]
+    arrow = "↑" if delta > eps else ("↓" if delta < -eps else "→")
+    return f"{arrow}{fmt(delta)}"
+
+
+def _fmt_delta_bytes(d: float) -> str:
+    return ("+" if d >= 0 else "-") + fmt_bytes(abs(d))
+
+
+def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
+         history=None, trend_window_s=0.0) -> int:
     try:
         sample = backend.sample()
     except BackendError as e:
@@ -184,8 +215,25 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
             hbm_cell,
             pct,
             duty,
-            f"{owner.namespace}/{owner.pod}" if owner else "-",
         ]
+        if history is not None:
+            cid = chip.info.chip_id
+            if chip.hbm_used_bytes is not None:
+                history.append("tpu_hbm_used_bytes", {"chip_id": str(cid)},
+                               chip.hbm_used_bytes)
+            if chip.tensorcore_duty_cycle_percent is not None:
+                history.append("tpu_tensorcore_duty_cycle_percent",
+                               {"chip_id": str(cid)},
+                               chip.tensorcore_duty_cycle_percent)
+            # Direction over the trailing window: ±0.5% of capacity (or
+            # 1 MiB) counts as movement for HBM, ±1 duty point for the core.
+            hbm_eps = max((chip.hbm_total_bytes or 0) * 0.005, 1024.0**2)
+            row.append(trend_cell(history, "tpu_hbm_used_bytes", cid,
+                                  trend_window_s, _fmt_delta_bytes, hbm_eps))
+            row.append(trend_cell(history, "tpu_tensorcore_duty_cycle_percent",
+                                  cid, trend_window_s,
+                                  lambda d: f"{d:+.1f}%", 1.0))
+        row.append(f"{owner.namespace}/{owner.pod}" if owner else "-")
         if scanner is not None:
             chip_holders = holders_by_path.get(chip.info.device_path, [])
             row.append(
@@ -214,7 +262,10 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False) -> int:
         }, indent=None if as_json == "line" else 1), flush=True)
         return 0
 
-    header = ["chip", "device", "hbm", "hbm%", "duty", "pod"]
+    header = ["chip", "device", "hbm", "hbm%", "duty"]
+    if history is not None:
+        header += ["Δhbm", "Δduty"]
+    header.append("pod")
     if scanner is not None:
         header.append("holder")
     print(render_table(rows, header))

@@ -111,8 +111,13 @@ class JsonLogFormatter(logging.Formatter):
         # RFC3339 with sub-second precision and a colon in the offset
         # ("+00:00") — strftime's %z yields "+0000", which strict Cloud
         # Logging parsers reject, silently falling back to ingestion time
-        # exactly when ordering matters (code-review r5).
-        ts = datetime.fromtimestamp(record.created, timezone.utc).isoformat()
+        # exactly when ordering matters (code-review r5). timespec pinned:
+        # bare isoformat() OMITS the fractional field when microsecond == 0
+        # (~one log line per million), flapping the timestamp shape under
+        # strict parsers (advisor r5).
+        ts = datetime.fromtimestamp(record.created, timezone.utc).isoformat(
+            timespec="microseconds"
+        )
         out = {
             "severity": record.levelname,
             "time": ts,
