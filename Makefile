@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test native proto bench history-demo clean
+.PHONY: test native proto bench history-demo chaos-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -9,6 +9,14 @@ test:
 # forensics path (deploy/RUNBOOK.md "Forensics after an incident").
 history-demo:
 	python -m tpu_pod_exporter.history --replay tests/fixtures/real-trace-r5.jsonl
+
+# Wedge a live in-process exporter's device backend (deterministic chaos
+# injection) and watch supervision recover it: the hung read is abandoned at
+# the phase deadline, the breaker opens, the backend reconnects, up returns
+# to 1 — while /metrics answers from the stale snapshot throughout
+# (deploy/RUNBOOK.md "Wedged source playbook").
+chaos-demo:
+	python -m tpu_pod_exporter.chaos
 
 native:
 	$(MAKE) -C native

@@ -1462,3 +1462,154 @@ class TestRoundRecordReplay:
                 store_live.current().value(name, key)
                 == store_replay.current().value(name, key)
             ), name
+
+
+class TestTargetCircuitBreaker:
+    """ISSUE 2: a persistently-down target is quarantined with backoff
+    instead of costing a full timeout_s in the scrape pool every round, and
+    its history fallback is not probed while quarantined."""
+
+    def _agg(self, fetch, history_fetch=None, **kw):
+        kw.setdefault("breaker_failures", 2)
+        kw.setdefault("breaker_backoff_s", 5.0)
+        kw.setdefault("breaker_backoff_max_s", 20.0)
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            ("h0:8000",), store, fetch=fetch,
+            history_fetch=history_fetch or (lambda url, t: (_ for _ in ()).throw(ConnectionError("no hist"))),
+            history_fallback_window_s=15.0 if history_fetch is not None else 0.0,
+            **kw,
+        )
+        # Deterministic breaker clock, jitter factor pinned to 1.
+        clock = [0.0]
+        br = agg._breakers["h0:8000"]
+        br._clock = lambda: clock[0]
+        br._rng = type("R", (), {"random": staticmethod(lambda: 0.5)})()
+        return agg, store, clock, br
+
+    def test_quarantine_skips_scrapes_and_errors(self):
+        calls = []
+
+        def fetch(target, timeout_s):
+            calls.append(target)
+            raise ConnectionError("down")
+
+        agg, store, clock, br = self._agg(fetch)
+        try:
+            for _ in range(2):  # threshold reached -> breaker opens
+                agg.poll_once()
+                clock[0] += 1.0
+            assert br.state == "open"
+            fetches_at_open = len(calls)
+            for _ in range(3):  # quarantined rounds: no fetch at all
+                agg.poll_once()
+                clock[0] += 1.0
+            assert len(calls) == fetches_at_open
+            snap = store.current()
+            # target reports down + quarantined, but the error counter only
+            # counts ATTEMPTED scrapes (2), not skipped rounds.
+            assert snap.value("tpu_aggregator_target_up", ("h0:8000",)) == 0.0
+            assert snap.value(
+                "tpu_aggregator_target_breaker_state", ("h0:8000",)
+            ) == 1.0
+            assert snap.value(
+                "tpu_aggregator_scrape_errors_total", ("h0:8000",)
+            ) == 2.0
+        finally:
+            agg.close()
+
+    def test_probe_after_backoff_and_recovery_closes(self):
+        down = {"v": True}
+        calls = []
+
+        def fetch(target, timeout_s):
+            calls.append(target)
+            if down["v"]:
+                raise ConnectionError("down")
+            return make_host_text(0)
+
+        agg, store, clock, br = self._agg(fetch)
+        try:
+            for _ in range(2):
+                agg.poll_once()
+            assert br.state == "open"
+            agg.poll_once()  # still inside backoff: skipped
+            assert len(calls) == 2
+            clock[0] += 5.0  # backoff (base 5, jitter pinned 1.0) elapsed
+            down["v"] = False
+            agg.poll_once()  # half-open probe succeeds
+            assert len(calls) == 3
+            assert br.state == "closed"
+            snap = store.current()
+            assert snap.value("tpu_aggregator_target_up", ("h0:8000",)) == 1.0
+            assert snap.value(
+                "tpu_aggregator_target_breaker_state", ("h0:8000",)
+            ) == 0.0
+        finally:
+            agg.close()
+
+    def test_history_fallback_not_probed_while_quarantined(self):
+        hist_calls = []
+
+        def history_fetch(url, timeout_s):
+            hist_calls.append(url)
+            raise ConnectionError("hist down too")
+
+        def fetch(target, timeout_s):
+            raise ConnectionError("down")
+
+        agg, store, clock, br = self._agg(fetch, history_fetch=history_fetch)
+        try:
+            for _ in range(2):
+                agg.poll_once()
+            # Both attempted rounds probed history once (bail-fast rule).
+            assert len(hist_calls) == 2
+            for _ in range(4):  # quarantined rounds: history NOT probed
+                agg.poll_once()
+            assert len(hist_calls) == 2
+        finally:
+            agg.close()
+
+    def test_breaker_disabled_scrapes_every_round(self):
+        calls = []
+
+        def fetch(target, timeout_s):
+            calls.append(target)
+            raise ConnectionError("down")
+
+        store = SnapshotStore()
+        agg = SliceAggregator(("h0:8000",), store, fetch=fetch,
+                              breaker_failures=0)
+        try:
+            for _ in range(5):
+                agg.poll_once()
+            assert len(calls) == 5  # pre-breaker behaviour
+            assert store.current().value(
+                "tpu_aggregator_target_breaker_state", ("h0:8000",)
+            ) is None  # no breaker, no series
+        finally:
+            agg.close()
+
+    def test_recovery_logs_warning(self, caplog):
+        import logging as _logging
+
+        down = {"v": True}
+
+        def fetch(target, timeout_s):
+            if down["v"]:
+                raise ConnectionError("down")
+            return make_host_text(0)
+
+        agg, store, clock, br = self._agg(fetch)
+        try:
+            with caplog.at_level(_logging.WARNING,
+                                 logger="tpu_pod_exporter.aggregate"):
+                agg.poll_once()
+                down["v"] = False
+                agg.poll_once()
+            assert any(
+                "healthy again after 1 failed scrape(s)" in r.getMessage()
+                for r in caplog.records
+            )
+        finally:
+            agg.close()

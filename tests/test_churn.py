@@ -104,16 +104,24 @@ class TestChurn:
     def test_series_count_bounded_under_churn(self, churn_app):
         app, attr = churn_app
         # Warm up past the startup snapshot: ICI bandwidth series exist only
-        # from the second sampled poll (a rate needs a dt window), and the
+        # from the second sampled poll (a rate needs a dt window), the
         # scrape-duration histogram's series exist only once a poll AFTER
-        # the first scrape emits its observation — either appearing
-        # mid-loop would skew the count (by 32 and 14 series respectively).
+        # the first scrape emits its observation, and the three
+        # allocation-dependent series (pod rollups + kubelet allocated)
+        # exist only once the first allocation is polled — any of them
+        # appearing mid-loop would skew the count (by 32, 14, and 3
+        # series respectively), so seed an allocation and wait for all of
+        # them before counting.
+        attr.set_allocations(
+            [simple_allocation("pod-warm", [str(i) for i in range(CHIPS)])]
+        )
         deadline = time.time() + 5
         while time.time() < deadline:
             text = scrape(app.port)
             if (
                 "tpu_ici_link_bandwidth_bytes_per_second{" in text
                 and "tpu_exporter_scrape_duration_seconds_count" in text
+                and "tpu_pod_chip_count{" in text
             ):
                 break
             time.sleep(0.01)

@@ -3,6 +3,7 @@ timing out mid-poll must degrade the exporter, never kill it — the inversion
 of the reference's log.Fatalf-in-loop behavior (main.go:119-137)."""
 
 import time
+import urllib.error
 import urllib.request
 
 import pytest
@@ -28,7 +29,13 @@ def fams_of(port):
 def app_with_fakes():
     backend = FakeBackend(chips=2)
     attr = FakeAttribution([simple_allocation("p", ["0", "1"])])
-    cfg = ExporterConfig(port=0, host="127.0.0.1", interval_s=0.02)
+    # Breaker backoff scaled to the 0.02 s test interval (production
+    # defaults are seconds): a 10-failure burst opens the breaker and must
+    # still drain through half-open probes within the tests' 5 s waits.
+    cfg = ExporterConfig(
+        port=0, host="127.0.0.1", interval_s=0.02,
+        breaker_backoff_s=0.05, breaker_backoff_max_s=0.1,
+    )
     app = ExporterApp(cfg, backend=backend, attribution=attr)
     app.start()
     yield app, backend, attr
@@ -101,6 +108,62 @@ class TestFaultInjection:
         # last-good attribution still applied through the flaps
         assert all(s.labels["pod"] == "p" for s in used)
 
+    def test_wedged_backend_abandoned_at_phase_deadline(self):
+        """A backend that HANGS (not errors) must not park the poll loop:
+        the supervised call is abandoned at --phase-deadline-s, up drops,
+        scrapes stay fast, and recovery follows once the wedge clears."""
+        import threading
+
+        backend = FakeBackend(chips=2)
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", interval_s=0.02,
+            phase_deadline_s=0.15,
+            breaker_failures=2, breaker_backoff_s=0.05,
+            breaker_backoff_max_s=0.1,
+        )
+        app = ExporterApp(cfg, backend=backend, attribution=FakeAttribution())
+        app.start()
+        try:
+            release = threading.Event()
+            inner = backend.sample
+
+            def wedged():
+                release.wait(5.0)
+                return inner()
+
+            backend.sample = wedged  # type: ignore[method-assign]
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                fams = fams_of(app.port)
+                if fams["tpu_exporter_up"].samples[0].value == 0:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError("up never dropped during the wedge")
+            # Scrapes serve the stale snapshot instantly.
+            t0 = time.monotonic()
+            scrape(app.port)
+            assert time.monotonic() - t0 < 0.15
+            abandoned = {
+                s.labels["source"]: s.value
+                for s in fams_of(app.port)[
+                    "tpu_exporter_source_calls_abandoned"
+                ].samples
+            }
+            assert abandoned.get("device", 0) >= 1
+            # Clear the wedge; the breaker probes and the exporter recovers.
+            release.set()
+            backend.sample = inner  # type: ignore[method-assign]
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if fams_of(app.port)["tpu_exporter_up"].samples[0].value == 1:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError("never recovered after the wedge cleared")
+        finally:
+            app.stop()
+
     def test_poison_backend_exception_type(self, app_with_fakes):
         """Non-BackendError exceptions are still contained by the loop."""
         app, backend, _ = app_with_fakes
@@ -125,3 +188,73 @@ class TestFaultInjection:
         }
         assert errs.get("device_read", 0) >= 1
         assert scrape(app.port)
+
+
+class TestPollLoopThreadDeath:
+    """Regression (ISSUE 2 satellite): per-iteration containment catches
+    Exception, but a BaseException escaping poll_once kills the loop thread.
+    The loop supervisor restarts it ONCE; a second death marks the loop dead
+    and /healthz must go 503 immediately (not after health_max_age_s)."""
+
+    def _healthz(self, port):
+        try:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=5
+            ) as r:
+                return r.status, r.read().decode()
+        except urllib.error.HTTPError as e:
+            return e.code, e.read().decode()
+
+    def test_loop_death_restarts_once_then_healthz_503(self, app_with_fakes):
+        app, _, _ = app_with_fakes
+        wait_polls(app.port, 2)
+        assert self._healthz(app.port)[0] == 200
+
+        def die():
+            raise SystemExit("poll thread killed")  # BaseException: escapes containment
+
+        app.collector.poll_once = die  # type: ignore[method-assign]
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            status, body = self._healthz(app.port)
+            if status == 503:
+                break
+            time.sleep(0.01)
+        else:
+            raise AssertionError("healthz never went 503 after loop death")
+        assert "poll loop dead" in body
+        assert app.loop.restarts == 1  # exactly one supervised restart
+        assert app.loop.dead
+        # The exporter still serves (stale) metrics and debug surface.
+        assert scrape(app.port)
+
+    def test_single_death_recovers_via_restart(self):
+        backend = FakeBackend(chips=1)
+        cfg = ExporterConfig(port=0, host="127.0.0.1", interval_s=0.02)
+        app = ExporterApp(cfg, backend=backend, attribution=FakeAttribution())
+        app.start()
+        try:
+            real = app.collector.poll_once
+            fired = {"n": 0}
+
+            def die_once():
+                if fired["n"] == 0:
+                    fired["n"] = 1
+                    raise SystemExit("one-shot death")
+                return real()
+
+            app.collector.poll_once = die_once  # type: ignore[method-assign]
+            start_polls = fams_of(app.port)["tpu_exporter_polls"].samples[0].value
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                fams = fams_of(app.port)
+                if fams["tpu_exporter_polls"].samples[0].value > start_polls + 2:
+                    break
+                time.sleep(0.01)
+            else:
+                raise AssertionError("loop never resumed after one death")
+            assert app.loop.restarts == 1
+            assert not app.loop.dead
+            assert self._healthz(app.port)[0] == 200
+        finally:
+            app.stop()

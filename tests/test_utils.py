@@ -53,6 +53,72 @@ class TestRateLimitedLogger:
         rl.warning("b", "b-msg")
         assert records == ["a-msg", "b-msg"]
 
+    def test_recovery_bypasses_fault_rate_limit(self):
+        """ISSUE 2 satellite: an incident's recovery must log (at WARNING)
+        even deep inside the fault lines' suppression window — operators
+        must see the end of an incident, not just its start."""
+        now = [0.0]
+        rl, records = make(now)
+        for _ in range(5):
+            rl.warning("k", "source down")
+        now[0] = 10.0  # deep inside the fault key's 30 s window
+        rl.recovery("k", "source healthy again after %d failures", 4)
+        assert records == [
+            "source down",
+            "source healthy again after 4 failures",
+        ]
+
+    def test_recovery_logs_at_warning_level(self):
+        now = [0.0]
+        records = []
+
+        class Capture(logging.Handler):
+            def emit(self, record):
+                records.append((record.levelno, record.getMessage()))
+
+        logger = logging.getLogger(f"test-rl-lvl-{id(records)}")
+        logger.setLevel(logging.DEBUG)
+        logger.addHandler(Capture())
+        logger.propagate = False
+        rl = RateLimitedLogger(logger, clock=lambda: now[0])
+        rl.recovery("k", "healthy again")
+        assert records == [(logging.WARNING, "healthy again")]
+
+    def test_repeated_recoveries_are_themselves_throttled(self):
+        now = [0.0]
+        rl, records = make(now)
+        rl.recovery("k", "recovered")
+        now[0] = 10.0
+        rl.recovery("k", "recovered")  # inside the recovery window
+        now[0] = 45.0
+        rl.recovery("k", "recovered")
+        assert records == [
+            "recovered",
+            "recovered (+1 similar suppressed)",
+        ]
+
+    def test_flapping_source_does_not_spam_through_recovery(self):
+        """A fail→recover flap every tick must stay throttled: the fault
+        window is untouched by recoveries and the recovery line rides its
+        own window, instead of two unthrottled WARNINGs per flap cycle."""
+        now = [0.0]
+        rl, records = make(now)
+        for _ in range(20):  # 20 flap cycles inside one 30 s window
+            rl.warning("k", "down")
+            now[0] += 0.5
+            rl.recovery("k", "up again")
+            now[0] += 0.5
+        # One fault line + one recovery line for the whole window.
+        assert records == ["down", "up again"]
+        # Next window: one more of each, carrying the suppressed tallies.
+        now[0] = 45.0
+        rl.warning("k", "down")
+        rl.recovery("k", "up again")
+        assert records[2:] == [
+            "down (+19 similar suppressed)",
+            "up again (+19 similar suppressed)",
+        ]
+
     def test_levels(self):
         now = [0.0]
         rl, records = make(now)

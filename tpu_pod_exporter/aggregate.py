@@ -44,6 +44,7 @@ from tpu_pod_exporter.metrics.parse import (
 )
 
 from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter.supervisor import CLOSED, STATE_VALUES, CircuitBreaker
 from tpu_pod_exporter import utils
 from tpu_pod_exporter.utils import RateLimitedLogger
 
@@ -269,6 +270,9 @@ class SliceAggregator:
         loop_overruns_fn=None,  # () -> int, from the CollectorLoop
         history_fallback_window_s: float = 0.0,
         history_fetch=default_history_fetch,
+        breaker_failures: int = 3,
+        breaker_backoff_s: float = 10.0,
+        breaker_backoff_max_s: float = 120.0,
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
@@ -287,6 +291,24 @@ class SliceAggregator:
         # tpu_aggregator_history_fallbacks_total.
         self._history_window_s = history_fallback_window_s
         self._history_fetch = history_fetch
+        # Per-target circuit breakers (tpu_pod_exporter.supervisor): a
+        # persistently-down target is QUARANTINED with exponential
+        # backoff+jitter instead of costing a full timeout_s in the scrape
+        # pool every round — at 64 targets and 2 s timeouts a handful of
+        # black-holed hosts would otherwise dominate round latency. While a
+        # target is quarantined its history fallback is skipped too (same
+        # dead endpoint). breaker_failures=0 disables (every target scraped
+        # every round, the pre-breaker behaviour).
+        self._breakers: dict[str, CircuitBreaker] | None = None
+        if breaker_failures > 0:
+            self._breakers = {
+                t: CircuitBreaker(
+                    failure_threshold=breaker_failures,
+                    backoff_base_s=breaker_backoff_s,
+                    backoff_max_s=breaker_backoff_max_s,
+                )
+                for t in targets
+            }
         self._wallclock = wallclock
         self._counters = CounterStore()
         self._rlog = RateLimitedLogger(log)
@@ -311,8 +333,36 @@ class SliceAggregator:
 
     def poll_once(self) -> None:
         t0 = time.monotonic()
+        # Round-local quarantine set: targets whose breaker skipped the
+        # scrape entirely this round (set.add is GIL-atomic; each pool
+        # worker touches a distinct target exactly once).
+        quarantined: set[str] = set()
+
+        def scrape(target: str) -> tuple[str, str | None, float]:
+            br = self._breakers.get(target) if self._breakers else None
+            if br is not None and br.decide() == "skip":
+                quarantined.add(target)
+                return target, None, 0.0
+            out = self._scrape_one(target)
+            if br is not None:
+                if out[1] is None:
+                    br.record_failure()
+                elif br.consecutive_failures or br.state != CLOSED:
+                    # Recovery bypasses the rate limit: the scrape-failure
+                    # lines for this target were suppressed to one per
+                    # window, and the incident's END must never be.
+                    self._rlog.recovery(
+                        f"scrape:{target}",
+                        "target %s healthy again after %d failed scrape(s)",
+                        target, br.consecutive_failures,
+                    )
+                    br.record_success()
+                else:
+                    br.record_success()
+            return out
+
         results = list(
-            self._pool.map(self._scrape_one, self._targets)
+            self._pool.map(scrape, self._targets)
         )  # [(target, text|None, duration_s)]
         if self._recorder is not None:
             try:
@@ -321,14 +371,22 @@ class SliceAggregator:
                 self._rlog.warning("recorder", "round record failed: %s", e)
         fallbacks: dict[str, list] = {}
         if self._history_window_s > 0:
-            failed = [t for t, text, _d in results if text is None]
+            # Quarantined targets are excluded: their scrape was skipped
+            # BECAUSE the endpoint is persistently dead, and the history
+            # API lives on the same dead port — probing it would burn the
+            # very timeout the breaker exists to save.
+            failed = [
+                t for t, text, _d in results
+                if text is None and t not in quarantined
+            ]
             if failed:
                 for target, samples in zip(
                     failed, self._pool.map(self._history_fallback, failed)
                 ):
                     if samples:
                         fallbacks[target] = samples
-        self._publish(results, fallbacks=fallbacks, round_started=t0)
+        self._publish(results, fallbacks=fallbacks, round_started=t0,
+                      quarantined=quarantined)
 
     def _history_fallback(self, target: str) -> list | None:
         """Last-known chip data from a down target's flight recorder, as
@@ -407,11 +465,13 @@ class SliceAggregator:
     # ---------------------------------------------------------------- publish
 
     def _publish(self, results, fallbacks=None,
-                 round_started: float | None = None) -> None:
+                 round_started: float | None = None,
+                 quarantined: set | None = None) -> None:
         b = SnapshotBuilder()
         for spec in schema.AGGREGATE_SPECS:
             b.declare(spec)
         fallbacks = fallbacks or {}
+        quarantined = quarantined or set()
 
         slices: dict[tuple[str, str], _SliceAgg] = {}
         workloads: dict[tuple[str, str, str], _WorkloadAgg] = {}
@@ -438,9 +498,13 @@ class SliceAggregator:
                 else:
                     self._consume(samples, slices, workloads, slice_groups)
             if not ok:
-                self._counters.inc(
-                    schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name, (target,)
-                )
+                # A quarantined round was SKIPPED, not attempted — the
+                # error counter keeps meaning "failed scrapes", so the
+                # breaker must not inflate it while saving timeouts.
+                if target not in quarantined:
+                    self._counters.inc(
+                        schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name, (target,)
+                    )
                 fb = fallbacks.get(target)
                 if fb:
                     # Missed-round continuity: the target's flight recorder
@@ -452,6 +516,12 @@ class SliceAggregator:
                         schema.TPU_AGG_HISTORY_FALLBACKS_TOTAL.name, (target,)
                     )
             b.add(schema.TPU_AGG_TARGET_UP, 1.0 if ok else 0.0, (target,))
+            if self._breakers is not None:
+                b.add(
+                    schema.TPU_AGG_TARGET_BREAKER_STATE,
+                    STATE_VALUES[self._breakers[target].state],
+                    (target,),
+                )
             b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
             if text is not None:
                 # Successful fetches only: a down target's timeout (~2 s
@@ -718,6 +788,21 @@ class SliceAggregator:
                 t: layout.oversize_logged
                 for t, layout in self._parse_layouts.items()
             },
+            # Per-target breaker view (None = breakers disabled): state plus
+            # how long until a quarantined target's next probe.
+            "target_breakers": (
+                {
+                    t: {
+                        "state": br.state,
+                        "consecutive_failures": br.consecutive_failures,
+                        "reopens": br.reopens,
+                        "next_probe_in_s": round(br.seconds_until_probe, 3),
+                    }
+                    for t, br in self._breakers.items()
+                }
+                if self._breakers is not None
+                else None
+            ),
         }
 
     def close(self) -> None:
@@ -741,6 +826,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="/debug/* exposure: loopback clients only by "
                         "default; 0.0.0.0 serves them to any client "
                         "(same policy as the exporter's --debug-addr)")
+    p.add_argument("--breaker-failures", type=int, default=3,
+                   help="consecutive scrape failures before a target is "
+                        "quarantined with backoff instead of burning "
+                        "--timeout-s every round (0 disables the breaker)")
+    p.add_argument("--breaker-backoff-s", type=float, default=0.0,
+                   help="first quarantine window; doubles per reopen "
+                        "(default 0 = auto: max(2x --interval-s, "
+                        "--timeout-s))")
+    p.add_argument("--breaker-backoff-max-s", type=float, default=120.0)
     p.add_argument("--history-fallback-window", type=float, default=0.0,
                    help="when a target's scrape fails, query its history "
                         "flight recorder (/api/v1/window_stats) over this "
@@ -774,6 +868,10 @@ def main(argv: list[str] | None = None) -> int:
     if ns.replay_from and targets == ("-",):
         targets = fetch.targets
     store = SnapshotStore()
+    breaker_backoff_s = (
+        ns.breaker_backoff_s if ns.breaker_backoff_s > 0
+        else max(2.0 * ns.interval_s, ns.timeout_s)
+    )
     agg = SliceAggregator(
         targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder,
         # Late-bound closure (the loop is constructed just below; the
@@ -781,6 +879,13 @@ def main(argv: list[str] | None = None) -> int:
         # surface as tpu_aggregator_poll_overruns_total.
         loop_overruns_fn=lambda: loop.overruns,
         history_fallback_window_s=ns.history_fallback_window,
+        breaker_failures=ns.breaker_failures,
+        # Auto backoff tracks the round cadence: the first quarantine skips
+        # about one round, growing from there; never below the scrape
+        # timeout (probing faster than a timeout resolves is pointless).
+        breaker_backoff_s=breaker_backoff_s,
+        # The ceiling must admit the base (huge --interval-s setups).
+        breaker_backoff_max_s=max(ns.breaker_backoff_max_s, breaker_backoff_s),
     )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(

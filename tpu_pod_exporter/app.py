@@ -197,6 +197,75 @@ class ExporterApp:
                 full_scan_every=cfg.process_full_scan_every,
             )
         self.process_scanner = scanner
+        # Deterministic fault injection (TEST ONLY, --chaos-spec): wraps the
+        # sources BEFORE supervision so injected hangs/errors exercise the
+        # real deadline/breaker/reconnect path.
+        self.chaos = {}
+        if cfg.chaos_spec:
+            from tpu_pod_exporter.chaos import apply_chaos
+
+            log.warning("chaos injection active (spec=%r seed=%d) — "
+                        "test-only configuration", cfg.chaos_spec, cfg.chaos_seed)
+            self.backend, self.attribution, scanner, self.chaos = apply_chaos(
+                cfg.chaos_spec, cfg.chaos_seed,
+                self.backend, self.attribution, scanner,
+            )
+            self.process_scanner = scanner
+        # Source supervision (tpu_pod_exporter.supervisor): per-phase
+        # deadlines + circuit breakers + breaker-gated reconnects.
+        # --phase-deadline-s 0 disables (direct in-thread calls).
+        self.supervisors = {}
+        if cfg.phase_deadline_s > 0:
+            from tpu_pod_exporter.supervisor import (
+                CircuitBreaker,
+                SourceSupervisor,
+            )
+
+            def _breaker() -> CircuitBreaker:
+                # --breaker-failures 0 disables the breaker (same contract
+                # as the aggregator flag) while keeping phase deadlines: an
+                # unreachable threshold means the state machine never
+                # leaves closed. Backoffs are clamped sane rather than
+                # crashing startup on a zero/inverted pair.
+                threshold = (
+                    cfg.breaker_failures if cfg.breaker_failures > 0
+                    else (1 << 30)
+                )
+                base = (
+                    cfg.breaker_backoff_s if cfg.breaker_backoff_s > 0 else 1.0
+                )
+                return CircuitBreaker(
+                    failure_threshold=threshold,
+                    backoff_base_s=base,
+                    backoff_max_s=max(cfg.breaker_backoff_max_s, base),
+                )
+
+            # Late-bound fns (lambda: self.backend...) so tests that
+            # monkeypatch .sample/.snapshot on the instances keep working;
+            # reconnect = close(): both gRPC clients lazily rebuild their
+            # channel on the next call, so close-then-call IS the reconnect.
+            self.supervisors["device"] = SourceSupervisor(
+                "device",
+                lambda: self.backend.sample(),
+                reconnect=lambda: self.backend.close(),
+                deadline_s=cfg.phase_deadline_s,
+                breaker=_breaker(),
+            )
+            self.supervisors["attribution"] = SourceSupervisor(
+                "attribution",
+                lambda: self.attribution.snapshot(),
+                reconnect=lambda: self.attribution.close(),
+                deadline_s=cfg.phase_deadline_s,
+                breaker=_breaker(),
+            )
+            if self.process_scanner is not None:
+                self.supervisors["process_scan"] = SourceSupervisor(
+                    "process_scan",
+                    lambda: self.process_scanner.scan(),
+                    reconnect=None,  # procfs has no channel to replace
+                    deadline_s=cfg.phase_deadline_s,
+                    breaker=_breaker(),
+                )
         # Flight-recorder history (--history-retention-s 0 disables): ring
         # capacity is one sample per poll over the retention window, capped
         # so a sub-second interval cannot balloon the preallocation. Hard
@@ -234,6 +303,7 @@ class ExporterApp:
             loop_overruns_fn=lambda: self.loop.overruns,
             scrape_duration_hist=scrape_hist,
             history=self.history,
+            supervisors=self.supervisors,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -250,7 +320,38 @@ class ExporterApp:
             scrape_observer=scrape_hist.observe,
             history=self.history,
             debug_addr=cfg.debug_addr,
+            live_fn=self._live_check,
+            ready_detail_fn=self._ready_detail,
         )
+
+    def _live_check(self) -> str | None:
+        """Immediate liveness failure when the poll loop is truly dead (its
+        one supervised restart is spent) — /healthz must not wait out
+        health_max_age_s to report a thread that will never poll again."""
+        if self.loop.dead:
+            return (
+                f"poll loop dead (thread died twice; "
+                f"{self.loop.restarts} restart(s) used)"
+            )
+        return None
+
+    def _ready_detail(self) -> dict:
+        """Degraded-source detail for the /readyz JSON body: any source
+        whose breaker has (re-)opened across several probes. Detail only —
+        the HTTP status stays governed by first-poll completion."""
+        degraded = [
+            {
+                "source": source,
+                "breaker_state": st["state"],
+                "reopens": st["reopens"],
+                "abandoned": st["abandoned"],
+                "reconnects": st["reconnects"],
+                "next_probe_in_s": round(st["seconds_until_probe"], 3),
+            }
+            for source, sup in self.supervisors.items()
+            if (st := sup.stats())["degraded"]
+        ]
+        return {"degraded_sources": degraded} if degraded else {}
 
     def _debug_vars(self) -> dict:
         """Introspection payload for /debug/vars (SURVEY.md §5: per-phase
@@ -273,6 +374,7 @@ class ExporterApp:
             "last_poll": {
                 "ok": stats.ok,
                 "errors": list(stats.errors),
+                "skipped": list(stats.skipped),
                 "device_read_s": stats.device_read_s,
                 "attribution_s": stats.attribution_s,
                 "process_scan_s": stats.process_scan_s,
@@ -281,6 +383,8 @@ class ExporterApp:
                 "total_s": stats.total_s,
             },
             "loop_overruns": self.loop.overruns,
+            "loop_restarts": self.loop.restarts,
+            "loop_dead": self.loop.dead,
             "series": snap.series_count,
             "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
             "scrape_rejects": dict(self.server.scrape_rejects),
@@ -292,6 +396,15 @@ class ExporterApp:
             }
         if self.history is not None:
             out["history"] = self.history.stats()
+        if self.supervisors:
+            out["supervisors"] = {
+                source: sup.stats() for source, sup in self.supervisors.items()
+            }
+        if self.chaos:
+            out["chaos"] = {
+                source: {"calls": w.calls, "injected": w.injected[-50:]}
+                for source, w in self.chaos.items()
+            }
         return out
 
     @property

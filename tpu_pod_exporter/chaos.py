@@ -1,0 +1,394 @@
+"""Deterministic fault injection — the chaos harness for source supervision.
+
+Wraps any poll source (device backend, attribution provider, process
+scanner) and injects faults on a **seeded, reproducible schedule**:
+
+- ``hang``    — block the call for a duration (exercises the phase
+  deadline + abandoned-worker path in ``supervisor.py``);
+- ``err``     — raise :class:`ChaosError` (the ordinary error-containment
+  path);
+- ``slow``    — add latency, then proceed (deadline-adjacent but returning);
+- ``garbage`` — return a *well-formed but bogus* value (negative HBM, NaN
+  duty cycle, label-hostile pod names) so value-robustness is exercised,
+  not just control flow.
+
+Spec grammar (``--chaos-spec``, test-only flag)::
+
+    spec  := rule ("," rule)*
+    rule  := kind ":" source (":" token)*
+    kind  := hang | err | slow | garbage
+    source:= device | attribution | procscan
+
+Tokens after the source are order-free: a bare float in [0, 1] is the
+per-call probability (default 1.0), a duration with a unit ("500ms",
+"10s", "0.3s") is the hang/slow length, and ``xN`` caps the rule at N
+injections total. Examples::
+
+    hang:device:0.01                 1% of device reads hang (default 3600s)
+    err:attribution:0.05             5% of attribution reads raise
+    slow:procscan:500ms              every process scan takes +500ms
+    hang:device:1:10s:x3             the first three device reads hang 10s
+
+Determinism: each source draws from its own ``random.Random`` seeded with
+``f"{seed}:{source}"``, and the single poll thread calls sources in a fixed
+order — so a given (spec, seed) injects the same faults on the same call
+indices on every run, regardless of wall-clock timing. Used by
+``tests/test_chaos.py`` and ``make chaos-demo``.
+"""
+
+from __future__ import annotations
+
+import logging
+import random
+import re
+import time
+from dataclasses import dataclass, field
+
+log = logging.getLogger("tpu_pod_exporter.chaos")
+
+KINDS = ("hang", "err", "slow", "garbage")
+SOURCES = ("device", "attribution", "procscan")
+
+DEFAULT_HANG_S = 3600.0   # "forever" at poll-loop scale; the deadline fences it
+DEFAULT_SLOW_S = 0.25
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)$")
+_COUNT_RE = re.compile(r"^x(\d+)$")
+
+
+class ChaosError(RuntimeError):
+    """An injected source failure (the ``err`` fault kind)."""
+
+
+@dataclass
+class ChaosRule:
+    kind: str
+    source: str
+    prob: float = 1.0
+    duration_s: float | None = None  # hang/slow length; kind-default if None
+    max_count: int | None = None     # total injection cap; None = unlimited
+    fired: int = field(default=0, compare=False)
+
+    @property
+    def effective_duration_s(self) -> float:
+        if self.duration_s is not None:
+            return self.duration_s
+        return DEFAULT_HANG_S if self.kind == "hang" else DEFAULT_SLOW_S
+
+
+def parse_chaos_spec(spec: str) -> list[ChaosRule]:
+    """``--chaos-spec`` string → rule list. Raises ValueError loudly on any
+    malformed rule — a typo'd chaos spec must fail at startup, not silently
+    inject nothing during the test it was written for."""
+    rules: list[ChaosRule] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        parts = raw.split(":")
+        if len(parts) < 2:
+            raise ValueError(f"chaos rule {raw!r}: want kind:source[:tokens]")
+        kind, source = parts[0].strip().lower(), parts[1].strip().lower()
+        if kind not in KINDS:
+            raise ValueError(f"chaos rule {raw!r}: unknown kind {kind!r} "
+                             f"(want one of {'/'.join(KINDS)})")
+        if source not in SOURCES:
+            raise ValueError(f"chaos rule {raw!r}: unknown source {source!r} "
+                             f"(want one of {'/'.join(SOURCES)})")
+        rule = ChaosRule(kind=kind, source=source)
+        for tok in parts[2:]:
+            tok = tok.strip().lower()
+            if not tok:
+                continue
+            m = _DURATION_RE.match(tok)
+            if m:
+                v = float(m.group(1))
+                rule.duration_s = v / 1000.0 if m.group(2) == "ms" else v
+                continue
+            m = _COUNT_RE.match(tok)
+            if m:
+                rule.max_count = int(m.group(1))
+                continue
+            try:
+                p = float(tok)
+            except ValueError:
+                raise ValueError(
+                    f"chaos rule {raw!r}: token {tok!r} is neither a "
+                    f"probability, a duration (500ms/10s), nor a count (x3)"
+                ) from None
+            if not 0.0 <= p <= 1.0:
+                raise ValueError(
+                    f"chaos rule {raw!r}: bare number {tok!r} must be a "
+                    f"probability in [0, 1]; use units for durations (e.g. "
+                    f"{tok}s)"
+                )
+            rule.prob = p
+        rules.append(rule)
+    if not rules:
+        raise ValueError(f"chaos spec {spec!r} contains no rules")
+    return rules
+
+
+# --- Garbage generators ------------------------------------------------------
+# Well-formed-but-bogus values, per wrapped method: they must flow through
+# the collector's normal code paths (that is the point — value robustness),
+# so the types are real, only the contents are hostile.
+
+
+def _garbage_sample(rng: random.Random):
+    from tpu_pod_exporter.backend import (
+        ChipInfo,
+        ChipSample,
+        HostSample,
+        IciLinkSample,
+    )
+
+    return HostSample(
+        chips=(
+            ChipSample(
+                info=ChipInfo(chip_id=999, device_path="/dev/chaos999"),
+                hbm_used_bytes=-float(rng.randrange(1, 2**40)),
+                hbm_total_bytes=0.0,
+                tensorcore_duty_cycle_percent=float("nan"),
+                # Counter regression: the monotonic fold must clamp it.
+                ici_links=(IciLinkSample("0", -1.0),),
+            ),
+        ),
+        partial_errors=("chaos: garbage sample",),
+    )
+
+
+def _garbage_snapshot(rng: random.Random):
+    from tpu_pod_exporter.attribution import (
+        AttributionSnapshot,
+        DeviceAllocation,
+    )
+
+    # Label-hostile identity: escaping bugs in the renderer or a consumer
+    # would corrupt the exposition framing exactly here.
+    return AttributionSnapshot(
+        allocations=(
+            DeviceAllocation(
+                pod='chaos"pod\n\\' + str(rng.randrange(10)),
+                namespace="chaos\tns",
+                container="c☃",
+                device_ids=("0",),
+            ),
+        ),
+    )
+
+
+def _garbage_scan(rng: random.Random):  # noqa: ARG001 — signature symmetry
+    return []
+
+
+_GARBAGE = {
+    "sample": _garbage_sample,
+    "snapshot": _garbage_snapshot,
+    "scan": _garbage_scan,
+}
+
+
+class ChaosWrapper:
+    """Duck-typed chaos proxy for any poll source.
+
+    Exposes ``sample``/``snapshot``/``scan`` (whichever the inner object
+    has is the one the collector calls) plus ``close()`` passthrough so the
+    supervisor's reconnect hook reaches the real source. Injections happen
+    *outside* any inner lock — a hang parks only the caller (or its
+    supervised worker), never the source's internal state.
+    """
+
+    def __init__(
+        self,
+        inner,
+        source: str,
+        rules: list[ChaosRule],
+        seed: int = 0,
+        sleep=time.sleep,
+    ) -> None:
+        self._inner = inner
+        self.source = source
+        self.rules = [r for r in rules if r.source == source]
+        self._rng = random.Random(f"{seed}:{source}")
+        # Garbage payload contents draw from their OWN stream: the schedule
+        # rng must consume exactly one draw per rule per call (the
+        # determinism invariant), and payload generation takes a varying
+        # number of draws.
+        self._garbage_rng = random.Random(f"{seed}:{source}:garbage")
+        self._sleep = sleep
+        self.calls = 0
+        # (call_index, kind) per injection — the deterministic schedule,
+        # asserted verbatim by tests.
+        self.injected: list[tuple[int, str]] = []
+
+    @property
+    def name(self) -> str:
+        return f"chaos({getattr(self._inner, 'name', '?')})"
+
+    def _invoke(self, method: str, *args, **kwargs):
+        idx = self.calls
+        self.calls += 1
+        # Every rule consumes exactly one rng draw per call, no matter what
+        # earlier rules did: the schedule of one rule can never shift
+        # because another rule fired, was capped out, or was removed —
+        # determinism is per (rule position, call index), not per hit. The
+        # first hitting, non-exhausted rule (spec order) is the one applied.
+        triggered: ChaosRule | None = None
+        for rule in self.rules:
+            draw = self._rng.random()
+            if (
+                triggered is None
+                and draw < rule.prob
+                and (rule.max_count is None or rule.fired < rule.max_count)
+            ):
+                triggered = rule
+        if triggered is not None:
+            triggered.fired += 1
+            self.injected.append((idx, triggered.kind))
+            log.debug("chaos: %s[%d] %s", self.source, idx, triggered.kind)
+            if triggered.kind in ("hang", "slow"):
+                # Sleep OUTSIDE any inner lock, then proceed with the real
+                # call — a wedged-then-released source returns real data.
+                self._sleep(triggered.effective_duration_s)
+            elif triggered.kind == "err":
+                raise ChaosError(
+                    f"chaos: injected {self.source} error (call {idx})"
+                )
+            elif triggered.kind == "garbage":
+                return _GARBAGE[method](self._garbage_rng)
+        return getattr(self._inner, method)(*args, **kwargs)
+
+    # The collector calls exactly one of these per source kind.
+    def sample(self):
+        return self._invoke("sample")
+
+    def snapshot(self):
+        return self._invoke("snapshot")
+
+    def scan(self):
+        return self._invoke("scan")
+
+    def close(self) -> None:
+        close = getattr(self._inner, "close", None)
+        if close is not None:
+            close()
+
+    def __getattr__(self, item):
+        # Introspection passthrough (e.g. FakeBackend.fail_next in tests).
+        return getattr(self._inner, item)
+
+
+def apply_chaos(spec: str, seed: int, backend, attribution, scanner):
+    """Wrap the three poll sources per ``spec``. Sources with no matching
+    rules are returned unwrapped; returns (backend, attribution, scanner,
+    {source: ChaosWrapper}) with the wrapper map for /debug/vars."""
+    rules = parse_chaos_spec(spec)
+    wrappers: dict[str, ChaosWrapper] = {}
+    by_source = {s: [r for r in rules if r.source == s] for s in SOURCES}
+    if by_source["device"] and backend is not None:
+        backend = wrappers["device"] = ChaosWrapper(
+            backend, "device", by_source["device"], seed
+        )
+    if by_source["attribution"] and attribution is not None:
+        attribution = wrappers["attribution"] = ChaosWrapper(
+            attribution, "attribution", by_source["attribution"], seed
+        )
+    if by_source["procscan"] and scanner is not None:
+        scanner = wrappers["procscan"] = ChaosWrapper(
+            scanner, "procscan", by_source["procscan"], seed
+        )
+    return backend, attribution, scanner, wrappers
+
+
+# --- Demo: a wedge, observed end to end --------------------------------------
+
+
+def main(argv: list[str] | None = None) -> int:
+    """``make chaos-demo``: wedge the device backend of a live in-process
+    exporter, watch the supervisor abandon the call, the breaker open,
+    the backend reconnect, and ``tpu_exporter_up`` return to 1 — while
+    /metrics keeps answering from the stale snapshot throughout."""
+    import argparse
+    import json
+    import urllib.request
+
+    from tpu_pod_exporter import utils as _utils
+    from tpu_pod_exporter.app import ExporterApp
+    from tpu_pod_exporter.config import ExporterConfig
+
+    p = argparse.ArgumentParser(
+        prog="tpu-pod-exporter-chaos",
+        description="Chaos demo: survive a wedged device backend, visibly.",
+    )
+    p.add_argument("--hang-s", type=float, default=6.0,
+                   help="how long each injected device hang blocks")
+    p.add_argument("--hangs", type=int, default=3,
+                   help="number of consecutive device reads that hang")
+    p.add_argument("--deadline-s", type=float, default=0.5)
+    p.add_argument("--interval-s", type=float, default=0.25)
+    p.add_argument("--timeout-s", type=float, default=60.0,
+                   help="give up if the exporter has not recovered by then")
+    p.add_argument("--seed", type=int, default=42)
+    ns = p.parse_args(argv)
+
+    _utils.setup_logging("warning")
+    cfg = ExporterConfig(
+        port=0, host="127.0.0.1", interval_s=ns.interval_s,
+        backend="fake", fake_chips=4, attribution="none",
+        phase_deadline_s=ns.deadline_s,
+        breaker_failures=2, breaker_backoff_s=0.5, breaker_backoff_max_s=2.0,
+        chaos_spec=f"hang:device:1:{ns.hang_s:g}s:x{ns.hangs}",
+        chaos_seed=ns.seed,
+        history_retention_s=0.0,
+    )
+    app = ExporterApp(cfg)
+    app.start()
+    base = f"http://127.0.0.1:{app.port}"
+    print(f"exporter up on {base}  "
+          f"(spec: {cfg.chaos_spec}, deadline {ns.deadline_s:g}s)")
+    saw_open = saw_reconnect = False
+    t0 = time.monotonic()
+    rc = 1
+    try:
+        while time.monotonic() - t0 < ns.timeout_s:
+            ts0 = time.monotonic()
+            with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
+                body = r.read().decode()
+            scrape_ms = (time.monotonic() - ts0) * 1000.0
+
+            def val(name: str, default: float = 0.0) -> float:
+                for line in body.splitlines():
+                    if line.startswith(name) and " " in line:
+                        try:
+                            return float(line.rsplit(" ", 1)[1])
+                        except ValueError:
+                            pass
+                return default
+
+            up = val("tpu_exporter_up ")
+            sup = app.supervisors["device"].stats()
+            print(f"t={time.monotonic() - t0:5.1f}s  up={up:g}  "
+                  f"breaker={sup['state']:<9}  abandoned={sup['abandoned']}  "
+                  f"reconnects={sup['reconnects']}  "
+                  f"skipped={sup['skipped']}  scrape={scrape_ms:.1f}ms")
+            saw_open = saw_open or sup["state"] != "closed"
+            saw_reconnect = saw_reconnect or sup["reconnects"] > 0
+            if saw_open and saw_reconnect and up == 1.0 and sup["state"] == "closed":
+                print("recovered: breaker closed, backend reconnected, up=1")
+                with urllib.request.urlopen(base + "/readyz", timeout=5) as r:
+                    print("readyz:", json.dumps(json.loads(r.read())))
+                rc = 0
+                break
+            time.sleep(max(ns.interval_s, 0.25))
+        else:
+            print("TIMEOUT: exporter did not recover", flush=True)
+    finally:
+        app.stop()
+    return rc
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -45,6 +45,7 @@ from tpu_pod_exporter.metrics import (
 from tpu_pod_exporter import utils
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.registry import PrefixCache
+from tpu_pod_exporter.supervisor import SourceSkipped, SourceTimeout
 from tpu_pod_exporter.topology import HostTopology
 from tpu_pod_exporter.utils import RateLimitedLogger
 from tpu_pod_exporter.version import __version__
@@ -64,6 +65,13 @@ class PollStats:
     total_s: float = 0.0
     ok: bool = True
     errors: tuple[str, ...] = ()
+    # Phases skipped by an open circuit breaker this poll. A skip degrades
+    # the phase exactly like an error (absent/stale data, up=0 for device)
+    # but is NOT a failure — it is the quarantine working — so it never
+    # counts into tpu_exporter_poll_errors_total (skips have their own
+    # counter, tpu_exporter_source_calls_skipped_total); same split the
+    # aggregator applies to its per-target scrape-error counter.
+    skipped: tuple[str, ...] = ()
 
 
 class Collector:
@@ -81,6 +89,7 @@ class Collector:
         loop_overruns_fn=None,   # () -> int, from the CollectorLoop
         scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
         history=None,  # HistoryStore fed after each snapshot swap
+        supervisors=None,  # {"device"|"attribution"|"process_scan": SourceSupervisor}
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
@@ -90,6 +99,15 @@ class Collector:
         self._scrape_rejects_fn = scrape_rejects_fn
         self._loop_overruns_fn = loop_overruns_fn
         self._store = store
+        # Optional supervision layer (tpu_pod_exporter.supervisor): when a
+        # source has a supervisor, its phase call runs on a fenced worker
+        # with a hard deadline behind a circuit breaker; without one, the
+        # call runs in-thread exactly as before (tests/bench construct the
+        # Collector bare).
+        self._supervisors = supervisors or {}
+        # Consecutive-failure counts per phase error key, for recovery log
+        # lines on the UNsupervised path (supervisors log their own).
+        self._phase_failures: dict[str, int] = {}
         self._topology = topology or HostTopology()
         self._resource_name = resource_name
         self._attribution_max_stale_s = attribution_max_stale_s
@@ -185,38 +203,68 @@ class Collector:
     def poll_once(self) -> PollStats:
         t0 = self._clock()
         errors: list[str] = []
+        skips: list[str] = []
 
         # Phase 1: device read (analog of main.go:116-138, error-contained).
+        # Supervised when a "device" supervisor exists: the call runs on a
+        # fenced worker with a hard deadline, behind the source's breaker.
         td0 = self._clock()
+        sup = self._supervisors.get("device")
         host_sample: HostSample | None = None
         try:
-            host_sample = self._backend.sample()
+            host_sample = sup.call() if sup is not None else self._backend.sample()
+            self._phase_recovered("device_read", supervised=sup is not None)
             for msg in host_sample.partial_errors:
                 errors.append("device_partial")
                 self._rlog.warning("device_partial", "device partial error: %s", msg)
+        except SourceSkipped as e:
+            # The breaker quarantine working as designed — the phase
+            # degrades like an error (stale/absent data is the truth), but
+            # it is neither counted as a poll error nor logged past INFO:
+            # the fault already logged when the breaker opened.
+            skips.append("device_read")
+            self._rlog.info("device_skip", "device read skipped: %s", e)
+        except SourceTimeout as e:
+            errors.append("device_read")
+            self._rlog.warning("device_timeout", "device read abandoned: %s", e)
         except BackendError as e:
             errors.append("device_read")
+            self._count_phase_failure("device_read", sup)
             self._rlog.warning("device_read", "device read failed: %s", e)
         except Exception as e:  # noqa: BLE001 — never die in the loop
             errors.append("device_read")
+            self._count_phase_failure("device_read", sup)
             self._rlog.error("device_read_unexpected", "device read failed unexpectedly: %s", e, exc_info=True)
         td1 = self._clock()
 
         # Phase 2: attribution (replaces main.go:74-114).
-        attr = self._read_attribution(errors)
+        attr = self._read_attribution(errors, skips)
         ta1 = self._clock()
 
         # Phase 2b: process scan (the honest analog of the reference's PID
         # harvest, main.go:92-109 — local procfs instead of kubectl exec).
         holders = None
         if self._process_scanner is not None:
+            psup = self._supervisors.get("process_scan")
             try:
-                holders = self._process_scanner.scan()
+                holders = (
+                    psup.call() if psup is not None
+                    else self._process_scanner.scan()
+                )
+                self._phase_recovered("process_scan", supervised=psup is not None)
                 self._last_holders = holders
                 self._last_holders_at = self._clock()
             except Exception as e:  # noqa: BLE001 — never die in the loop
-                errors.append("process_scan")
-                self._rlog.warning("process_scan", "process scan failed: %s", e)
+                if isinstance(e, SourceSkipped):
+                    skips.append("process_scan")
+                    self._rlog.info("process_scan_skip", "process scan skipped: %s", e)
+                elif isinstance(e, SourceTimeout):
+                    errors.append("process_scan")
+                    self._rlog.warning("process_scan_timeout", "process scan abandoned: %s", e)
+                else:
+                    errors.append("process_scan")
+                    self._count_phase_failure("process_scan", psup)
+                    self._rlog.warning("process_scan", "process scan failed: %s", e)
                 if (
                     self._last_holders is not None
                     and self._clock() - self._last_holders_at
@@ -245,8 +293,11 @@ class Collector:
             attribution_s=ta1 - td1,
             process_scan_s=tps1 - ta1,
             join_s=tj1 - tps1,
-            ok="device_read" not in errors,
+            # A skipped device phase degrades up exactly like a failed one:
+            # no device data was read either way.
+            ok="device_read" not in errors and "device_read" not in skips,
             errors=tuple(errors),
+            skipped=tuple(skips),
         )
         snap = self._publish(host_sample, device_owner, stats, now_mono=tj1,
                              allocatable=allocatable, allocated=allocated,
@@ -284,18 +335,29 @@ class Collector:
             self._history_append_s = self._clock() - th0
         return stats
 
-    def _read_attribution(self, errors: list[str]) -> AttributionSnapshot | None:
+    def _read_attribution(self, errors: list[str],
+                          skips: list[str]) -> AttributionSnapshot | None:
         now = self._clock()
+        sup = self._supervisors.get("attribution")
         try:
-            snap = self._attribution.snapshot()
+            snap = sup.call() if sup is not None else self._attribution.snapshot()
+            self._phase_recovered("attribution", supervised=sup is not None)
             self._last_attr = snap
             self._last_attr_at = now
             return snap
+        except SourceSkipped as e:
+            skips.append("attribution")
+            self._rlog.info("attribution_skip", "attribution read skipped: %s", e)
+        except SourceTimeout as e:
+            errors.append("attribution")
+            self._rlog.warning("attribution_timeout", "attribution read abandoned: %s", e)
         except AttributionError as e:
             errors.append("attribution")
+            self._count_phase_failure("attribution", sup)
             self._rlog.warning("attribution", "attribution read failed: %s", e)
         except Exception as e:  # noqa: BLE001
             errors.append("attribution")
+            self._count_phase_failure("attribution", sup)
             self._rlog.error("attribution_unexpected", "attribution failed unexpectedly: %s", e, exc_info=True)
         # Bounded-staleness reuse of the last good snapshot.
         if (
@@ -304,6 +366,26 @@ class Collector:
         ):
             return self._last_attr
         return None
+
+    # ------------------------------------------------- phase fault tracking
+
+    def _count_phase_failure(self, key: str, sup) -> None:
+        """Track consecutive failures for recovery log lines — only on the
+        unsupervised path (a SourceSupervisor tracks and logs its own)."""
+        if sup is None:
+            self._phase_failures[key] = self._phase_failures.get(key, 0) + 1
+
+    def _phase_recovered(self, key: str, supervised: bool) -> None:
+        if supervised:
+            return
+        n = self._phase_failures.get(key, 0)
+        if n:
+            self._phase_failures[key] = 0
+            # Bypasses the rate limit: the end of an incident must always
+            # be visible, even inside the fault lines' suppression window.
+            self._rlog.recovery(
+                key, "source %s healthy again after %d failure(s)", key, n
+            )
 
     # --------------------------------------------------------------- publish
 
@@ -566,6 +648,24 @@ class Collector:
                     float(v),
                     (f"attribution.{source}",),
                 )
+        # Source-supervision surface (tpu_pod_exporter.supervisor): breaker
+        # state + transition/abandon/skip/reconnect counters per source.
+        # Families are declared via ALL_SPECS either way; samples exist only
+        # when supervision is on.
+        for source, sup in self._supervisors.items():
+            st = sup.stats()
+            b.add(schema.TPU_EXPORTER_SOURCE_BREAKER_STATE,
+                  st["state_value"], (source,))
+            for state, n in st["transitions"].items():
+                b.add(schema.TPU_EXPORTER_SOURCE_BREAKER_TRANSITIONS_TOTAL,
+                      float(n), (source, state))
+            b.add(schema.TPU_EXPORTER_SOURCE_CALLS_ABANDONED_TOTAL,
+                  float(st["abandoned"]), (source,))
+            b.add(schema.TPU_EXPORTER_SOURCE_CALLS_SKIPPED_TOTAL,
+                  float(st["skipped"]), (source,))
+            b.add(schema.TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL,
+                  float(st["reconnects"]), (source,))
+
         polls = self._counters.inc(schema.TPU_EXPORTER_POLLS_TOTAL.name, ())
         b.add(schema.TPU_EXPORTER_POLLS_TOTAL, polls)
         b.add(
@@ -788,6 +888,8 @@ class Collector:
                 rec[3] = seq
 
     def close(self) -> None:
+        for sup in self._supervisors.values():
+            sup.shutdown()
         self._backend.close()
         self._attribution.close()
 
@@ -799,7 +901,18 @@ class CollectorLoop:
     (logs + counts overruns rather than queueing), and exits promptly on
     ``stop()`` — real SIGTERM drain for DaemonSet rolling updates, which the
     reference lacks entirely (SURVEY.md §3.4).
+
+    Thread-death supervision: per-iteration containment catches ``Exception``,
+    but a ``BaseException`` escaping ``poll_once`` (SystemExit from a
+    misbehaving dependency, MemoryError, a bug in the containment itself)
+    would silently kill the thread — snapshots stop swapping and only the
+    slow ``health_max_age_s`` staleness trip would notice. Instead the loop
+    is restarted ONCE; a second death marks it ``dead``, which the app's
+    ``/healthz`` hook reports as an immediate 503 so kubelet restarts the
+    pod promptly.
     """
+
+    MAX_RESTARTS = 1
 
     def __init__(self, collector: Collector, interval_s: float = 1.0) -> None:
         if interval_s <= 0:
@@ -808,13 +921,44 @@ class CollectorLoop:
         self.interval_s = interval_s
         self._stop = threading.Event()
         self._thread: threading.Thread | None = None
+        self._restart_lock = threading.Lock()
         self.overruns = 0
+        self.restarts = 0
+        self.dead = False
 
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("already started")
-        self._thread = threading.Thread(target=self._run, name="tpu-exporter-poll", daemon=True)
-        self._thread.start()
+        self._thread = self._spawn()
+
+    def _spawn(self) -> threading.Thread:
+        t = threading.Thread(
+            target=self._run_guarded, name="tpu-exporter-poll", daemon=True
+        )
+        t.start()
+        return t
+
+    def _run_guarded(self) -> None:
+        try:
+            self._run()
+        except BaseException:  # noqa: BLE001 — thread-death supervision
+            with self._restart_lock:
+                if self._stop.is_set():
+                    return
+                if self.restarts >= self.MAX_RESTARTS:
+                    self.dead = True
+                    log.critical(
+                        "poll loop died again (%d restart(s) used); staying "
+                        "down — /healthz reports 503", self.restarts,
+                        exc_info=True,
+                    )
+                    return
+                self.restarts += 1
+                log.critical(
+                    "poll loop thread died unexpectedly; restarting (%d/%d)",
+                    self.restarts, self.MAX_RESTARTS, exc_info=True,
+                )
+                self._thread = self._spawn()
 
     def _run(self) -> None:
         start = time.monotonic()
@@ -837,6 +981,8 @@ class CollectorLoop:
 
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
-        if self._thread is not None:
-            self._thread.join(timeout=timeout)
+        with self._restart_lock:  # the thread may have been restart-swapped
+            t = self._thread
             self._thread = None
+        if t is not None:
+            t.join(timeout=timeout)

@@ -40,6 +40,32 @@ class ExporterConfig:
     kubelet_pods_refresh_s: float = 30.0
     libtpu_metrics_addr: str = "localhost:8431"
     attribution_max_stale_s: float = 30.0
+    # Source supervision (tpu_pod_exporter.supervisor): hard per-phase
+    # deadline for device/attribution/process-scan reads. A call that
+    # exceeds it is ABANDONED (worker thread fenced off, phase degrades as
+    # an error) instead of parking the poll loop inside a wedged gRPC
+    # channel or hung /proc read. Default is 2x the longest source RPC
+    # timeout (podresources timeout_s=2.0): a healthy-but-slow call gets
+    # twice its own budget before being declared wedged. 0 disables
+    # supervision entirely (direct in-thread calls, pre-supervision
+    # behaviour).
+    phase_deadline_s: float = 4.0
+    # Circuit breaker per source: this many CONSECUTIVE failures (errors or
+    # deadline abandonments) open the breaker; while open, the phase is
+    # skipped (degrading as an error) until an exponential backoff+jitter
+    # window elapses, then a single half-open probe runs close()+re-open()
+    # on the source — a wedged channel is replaced, not retried into.
+    # 0 disables the breaker (phase deadlines still apply), matching the
+    # aggregator's --breaker-failures contract.
+    breaker_failures: int = 3
+    breaker_backoff_s: float = 1.0       # first open window; doubles per reopen
+    breaker_backoff_max_s: float = 30.0  # backoff ceiling
+    # Deterministic fault injection (tpu_pod_exporter.chaos) — TEST ONLY.
+    # e.g. "hang:device:0.01,err:attribution:0.05,slow:procscan:500ms";
+    # empty = disabled. Injection schedules are reproducible per
+    # (spec, chaos_seed).
+    chaos_spec: str = ""
+    chaos_seed: int = 0
     # /metrics concurrency cap: excess scrapers queue briefly then get 429
     # (0 disables). Protects the TPU host's cores from scrape storms.
     max_concurrent_scrapes: int = 4

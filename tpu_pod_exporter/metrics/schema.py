@@ -293,6 +293,46 @@ TPU_EXPORTER_SCRAPE_REJECTS_TOTAL = MetricSpec(
     label_names=("cause",),
 )
 
+# --- Source supervision (tpu_pod_exporter.supervisor) ------------------------
+# One series set per supervised source (device / attribution / process_scan).
+# Families are declared unconditionally (stable surface); samples appear
+# only when supervision is enabled (--phase-deadline-s > 0, the default).
+
+TPU_EXPORTER_SOURCE_BREAKER_STATE = MetricSpec(
+    name="tpu_exporter_source_breaker_state",
+    help="Circuit-breaker state of this poll source: 0=closed (healthy), 1=open (quarantined, backoff running), 2=half_open (single probe in flight).",
+    type=GAUGE,
+    label_names=("source",),
+)
+
+TPU_EXPORTER_SOURCE_BREAKER_TRANSITIONS_TOTAL = MetricSpec(
+    name="tpu_exporter_source_breaker_transitions_total",
+    help="Breaker state entries since exporter start, by source and entered state (state=closed counts recoveries; a never-failed source shows zero everywhere).",
+    type=COUNTER,
+    label_names=("source", "state"),
+)
+
+TPU_EXPORTER_SOURCE_CALLS_ABANDONED_TOTAL = MetricSpec(
+    name="tpu_exporter_source_calls_abandoned_total",
+    help="Supervised calls abandoned at the phase deadline (--phase-deadline-s): the worker thread was fenced off, the phase degraded as an error. Rising = the source HANGS rather than errors.",
+    type=COUNTER,
+    label_names=("source",),
+)
+
+TPU_EXPORTER_SOURCE_CALLS_SKIPPED_TOTAL = MetricSpec(
+    name="tpu_exporter_source_calls_skipped_total",
+    help="Poll-phase calls skipped because the source's breaker was open with backoff pending (the quarantine working as designed, not an extra fault).",
+    type=COUNTER,
+    label_names=("source",),
+)
+
+TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL = MetricSpec(
+    name="tpu_exporter_source_reconnects_total",
+    help="close()+re-open() reconnects issued before half-open breaker probes — a wedged gRPC channel is replaced, not retried into. Compare with breaker transitions to closed to see whether reconnects actually recover the source.",
+    type=COUNTER,
+    label_names=("source",),
+)
+
 TPU_EXPORTER_INFO = MetricSpec(
     name="tpu_exporter_info",
     help="Static exporter build/runtime info; value is always 1.",
@@ -393,6 +433,11 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_CPU_SECONDS_TOTAL,
     TPU_EXPORTER_RSS_BYTES,
     TPU_EXPORTER_SCRAPE_REJECTS_TOTAL,
+    TPU_EXPORTER_SOURCE_BREAKER_STATE,
+    TPU_EXPORTER_SOURCE_BREAKER_TRANSITIONS_TOTAL,
+    TPU_EXPORTER_SOURCE_CALLS_ABANDONED_TOTAL,
+    TPU_EXPORTER_SOURCE_CALLS_SKIPPED_TOTAL,
+    TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL,
     TPU_EXPORTER_INFO,
 )
 
@@ -555,6 +600,13 @@ TPU_AGG_SCRAPE_DURATION_SECONDS = MetricSpec(
     label_names=("target",),
 )
 
+TPU_AGG_TARGET_BREAKER_STATE = MetricSpec(
+    name="tpu_aggregator_target_breaker_state",
+    help="Per-target scrape circuit breaker: 0=closed, 1=open (target quarantined with backoff — its scrape AND history fallback are skipped instead of burning timeout_s every round), 2=half_open (probe in flight).",
+    type=GAUGE,
+    label_names=("target",),
+)
+
 TPU_AGG_SCRAPE_ERRORS_TOTAL = MetricSpec(
     name="tpu_aggregator_scrape_errors_total",
     help="Count of failed scrapes since aggregator start, by target.",
@@ -638,6 +690,7 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_WORKLOAD_HBM_USED_BYTES,
     TPU_WORKLOAD_HOSTS,
     TPU_AGG_TARGET_UP,
+    TPU_AGG_TARGET_BREAKER_STATE,
     TPU_AGG_SCRAPE_DURATION_SECONDS,
     TPU_AGG_SCRAPE_ERRORS_TOTAL,
     TPU_AGG_HISTORY_FALLBACKS_TOTAL,

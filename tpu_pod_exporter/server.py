@@ -207,6 +207,16 @@ class _Handler(BaseHTTPRequestHandler):
     # snapshots; liveness must catch that so kubelet restarts the pod —
     # serving stale bytes forever would look "up" while monitoring nothing.
     health_max_age_s: float = 0.0
+    # Optional () -> str|None liveness hook, checked before the staleness
+    # rule: a non-None reason fails /healthz IMMEDIATELY (e.g. the poll
+    # loop thread died and its one restart is spent) instead of waiting
+    # health_max_age_s for the snapshot to go stale.
+    live_fn = None
+    # Optional () -> dict merged into the /readyz JSON body — degraded
+    # readiness detail (e.g. sources whose circuit breaker has been open
+    # across several probes). Detail only: it never flips the status code;
+    # a degraded-but-serving exporter must keep its endpoints in rotation.
+    ready_detail_fn = None
     # Concurrency guard for /metrics: at most N handlers render/send at
     # once; excess requests queue briefly, then get 429 + Retry-After. A
     # misconfigured scrape storm (BENCH: ~1k scrapes/s ate half a core)
@@ -269,8 +279,16 @@ class _Handler(BaseHTTPRequestHandler):
             # wedged because handlers run on their own threads.
             self._serve_text(200, _format_stacks().encode())
         elif path == "/healthz":
+            reason = None
+            if self.live_fn is not None:
+                try:
+                    reason = type(self).live_fn()
+                except Exception as e:  # noqa: BLE001 — a broken hook is itself unhealthy
+                    reason = f"liveness hook failed: {e}"
             snap = self.store.current()
-            if (
+            if reason:
+                self._serve_text(503, f"{reason}\n".encode())
+            elif (
                 self.health_max_age_s > 0
                 and snap.timestamp > 0
                 and time.time() - snap.timestamp > self.health_max_age_s
@@ -283,10 +301,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._serve_text(200, b"ok\n")
         elif path == "/readyz":
             snap = self.store.current()
-            if snap.timestamp > 0:
-                self._serve_text(200, b"ready\n")
-            else:
-                self._serve_text(503, b"no poll completed yet\n")
+            ready = snap.timestamp > 0
+            body: dict = {"ready": ready}
+            if not ready:
+                body["reason"] = "no poll completed yet"
+            if self.ready_detail_fn is not None:
+                try:
+                    body.update(type(self).ready_detail_fn() or {})
+                except Exception:  # noqa: BLE001 — detail must not break probes
+                    pass
+            # JSON either way (kubelet only reads the status code; humans
+            # and the RUNBOOK read the degraded-source detail).
+            self._serve_json(200 if ready else 503, body)
         elif path == "/":
             self._serve_text(
                 200,
@@ -518,6 +544,8 @@ class MetricsServer:
         scrape_observer=None,
         history=None,
         debug_addr: str = "127.0.0.1",
+        live_fn=None,
+        ready_detail_fn=None,
     ) -> None:
         # Both causes pre-seeded so the self-metric publishes a 0 series
         # per cause from poll 1 (stable surface).
@@ -534,6 +562,10 @@ class MetricsServer:
                 ),
                 "debug_addr": debug_addr,
                 "health_max_age_s": health_max_age_s,
+                "live_fn": staticmethod(live_fn) if live_fn else None,
+                "ready_detail_fn": (
+                    staticmethod(ready_detail_fn) if ready_detail_fn else None
+                ),
                 "scrape_sem": (
                     threading.BoundedSemaphore(max_concurrent_scrapes)
                     if max_concurrent_scrapes > 0

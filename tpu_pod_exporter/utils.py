@@ -44,6 +44,23 @@ class RateLimitedLogger:
         self._last_emit[key] = now
         self._logger.log(level, msg, *args, **kwargs)
 
+    def recovery(self, key: str, msg: str, *args, **kwargs) -> None:
+        """Log a recovery transition at WARNING, independent of the fault
+        lines' rate limit.
+
+        The end of an incident must be as visible as its start: fault
+        lines for ``key`` are throttled to one per window while the source
+        is down, and a recovery landing inside that suppression window
+        must still log — operators would otherwise see incidents open and
+        never close. So recovery emits under its OWN window
+        (``key + ":recovered"``) rather than the faults': an isolated
+        incident's recovery always logs, no matter how recently a fault
+        line did. The same window throttles pathological flapping — a
+        source failing and recovering every poll logs one fault line and
+        one recovery line per window (each later carrying its suppressed
+        tally), not two unthrottled WARNINGs per flap cycle."""
+        self._emit(logging.WARNING, key + ":recovered", msg, *args, **kwargs)
+
     def warning(self, key: str, msg: str, *args, **kwargs) -> None:
         self._emit(logging.WARNING, key, msg, *args, **kwargs)
 
