@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test native proto bench history-demo chaos-demo clean
+.PHONY: test native proto bench history-demo chaos-demo trace-demo trace-overhead clean
 
 test:
 	python -m pytest tests/ -q
@@ -16,7 +16,21 @@ history-demo:
 # to 1 — while /metrics answers from the stale snapshot throughout
 # (deploy/RUNBOOK.md "Wedged source playbook").
 chaos-demo:
-	python -m tpu_pod_exporter.chaos
+	python -m tpu_pod_exporter.chaos --trace-out chaos-incident-trace.json
+
+# Replay the round-5 real-hardware trace through a TRACED collector and
+# print the rendered trace tree of the last poll — per-phase spans with
+# statuses, breaker states and series counts (deploy/RUNBOOK.md "Reading a
+# poll trace").
+trace-demo:
+	python -m tpu_pod_exporter.trace --replay tests/fixtures/real-trace-r5.jsonl
+
+# Tracing-is-on-by-default overhead contract: poll-loop CPU with tracing
+# on must stay within budget of tracing off on the bench/loadgen shape.
+# The local budget is the ISSUE's 5%; CI runs with a wider margin for
+# noisy shared runners (see .github/workflows/ci.yml).
+trace-overhead:
+	python -m tpu_pod_exporter.trace --overhead-check --polls 200 --chips 256 --budget 0.05
 
 native:
 	$(MAKE) -C native

@@ -21,6 +21,7 @@ Run: ``python -m tpu_pod_exporter.aggregate --targets h0:8000,h1:8000``.
 from __future__ import annotations
 
 import argparse
+import inspect
 import json
 import logging
 import signal
@@ -45,6 +46,7 @@ from tpu_pod_exporter.metrics.parse import (
 
 from tpu_pod_exporter.server import MetricsServer
 from tpu_pod_exporter.supervisor import CLOSED, STATE_VALUES, CircuitBreaker
+from tpu_pod_exporter.trace import format_traceparent
 from tpu_pod_exporter import utils
 from tpu_pod_exporter.utils import RateLimitedLogger
 
@@ -83,15 +85,24 @@ def default_history_fetch(url: str, timeout_s: float) -> dict:
         return json.loads(resp.read().decode("utf-8", errors="replace"))
 
 
-def default_fetch(target: str, timeout_s: float) -> str:
+def default_fetch(target: str, timeout_s: float,
+                  traceparent: str | None = None) -> str:
     """``host:port`` (or full URL) → exposition text.
 
     Asks for gzip: the exporters serve a lazily-cached compressed body
     (~20× smaller than the ~900 KB plain text at 256 chips), which matters
     when the aggregator scrapes every host of a slice over DCN each round.
+
+    ``traceparent`` (W3C Trace Context) carries the aggregator's round
+    trace + scrape span onto the exporter, which records its serve time as
+    a scrape span under that remote context — the cross-tier join asserted
+    in tests/test_trace.py.
     """
     url = target if target.startswith(("http://", "https://")) else f"http://{target}/metrics"
-    req = urllib.request.Request(url, headers={"Accept-Encoding": "gzip"})
+    headers = {"Accept-Encoding": "gzip"}
+    if traceparent:
+        headers["traceparent"] = traceparent
+    req = urllib.request.Request(url, headers=headers)
     with urllib.request.urlopen(req, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied targets
         body = resp.read()
         if (resp.headers.get("Content-Encoding") or "").lower() == "gzip":
@@ -273,10 +284,24 @@ class SliceAggregator:
         breaker_failures: int = 3,
         breaker_backoff_s: float = 10.0,
         breaker_backoff_max_s: float = 120.0,
+        tracer=None,
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
         self._targets = targets
+        # Round tracing (tpu_pod_exporter.trace): one trace per round, one
+        # span per target scrape / fallback / publish. The trace context
+        # propagates onto the fan-out via a traceparent header — only when
+        # the injected fetch accepts one (tests inject plain 2-arg fetches;
+        # ReplayFetch has no wire to stamp headers on).
+        self._tracer = tracer
+        self._fetch_traceparent = False
+        try:
+            self._fetch_traceparent = (
+                "traceparent" in inspect.signature(fetch).parameters
+            )
+        except (TypeError, ValueError):
+            pass
         self._recorder = recorder
         self._loop_overruns_fn = loop_overruns_fn
         self._store = store
@@ -333,6 +358,7 @@ class SliceAggregator:
 
     def poll_once(self) -> None:
         t0 = time.monotonic()
+        tr = self._tracer.start_poll() if self._tracer is not None else None
         # Round-local quarantine set: targets whose breaker skipped the
         # scrape entirely this round (set.add is GIL-atomic; each pool
         # worker touches a distinct target exactly once).
@@ -340,10 +366,34 @@ class SliceAggregator:
 
         def scrape(target: str) -> tuple[str, str | None, float]:
             br = self._breakers.get(target) if self._breakers else None
+            # Explicit span API (not the TLS begin/end): pool workers run
+            # concurrently, and PollTrace.span/end_span are safe from any
+            # thread (list.append is GIL-atomic).
+            span = (
+                tr.span("scrape", breaker=br.state if br is not None else "")
+                if tr is not None else None
+            )
             if br is not None and br.decide() == "skip":
                 quarantined.add(target)
+                if span is not None:
+                    span.add_event(
+                        f"quarantined: next probe in "
+                        f"{br.seconds_until_probe:.1f}s"
+                    )
+                    tr.end_span(span, "skipped", target=target)
                 return target, None, 0.0
-            out = self._scrape_one(target)
+            traceparent = (
+                format_traceparent(tr.trace_id, span.span_id)
+                if span is not None and self._fetch_traceparent
+                else None
+            )
+            out = self._scrape_one(target, traceparent)
+            if span is not None:
+                tr.end_span(
+                    span, "ok" if out[1] is not None else "err",
+                    target=target,
+                    bytes=len(out[1]) if out[1] is not None else 0,
+                )
             if br is not None:
                 if out[1] is None:
                     br.record_failure()
@@ -380,13 +430,36 @@ class SliceAggregator:
                 if text is None and t not in quarantined
             ]
             if failed:
+
+                def fallback(target: str):
+                    span = (
+                        tr.span("history_fallback") if tr is not None else None
+                    )
+                    samples = self._history_fallback(target)
+                    if span is not None:
+                        tr.end_span(
+                            span, "ok" if samples else "err", target=target,
+                            samples=len(samples) if samples else 0,
+                        )
+                    return samples
+
                 for target, samples in zip(
-                    failed, self._pool.map(self._history_fallback, failed)
+                    failed, self._pool.map(fallback, failed)
                 ):
                     if samples:
                         fallbacks[target] = samples
+        pspan = tr.span("publish") if tr is not None else None
         self._publish(results, fallbacks=fallbacks, round_started=t0,
                       quarantined=quarantined)
+        if tr is not None:
+            ok_n = sum(1 for _t, text, _d in results if text is not None)
+            tr.end_span(pspan, "ok")
+            self._tracer.finish(
+                tr,
+                status="ok" if ok_n else "err",
+                targets=len(self._targets), ok=ok_n,
+                quarantined=len(quarantined), fallbacks=len(fallbacks),
+            )
 
     def _history_fallback(self, target: str) -> list | None:
         """Last-known chip data from a down target's flight recorder, as
@@ -453,10 +526,15 @@ class SliceAggregator:
                     continue
         return samples or None
 
-    def _scrape_one(self, target: str) -> tuple[str, str | None, float]:
+    def _scrape_one(self, target: str,
+                    traceparent: str | None = None) -> tuple[str, str | None, float]:
         t0 = time.monotonic()
         try:
-            text = self._fetch(target, self._timeout_s)
+            if traceparent is not None:
+                text = self._fetch(target, self._timeout_s,
+                                   traceparent=traceparent)
+            else:
+                text = self._fetch(target, self._timeout_s)
         except Exception as e:  # noqa: BLE001 — a down host is data, not death
             self._rlog.warning(f"scrape:{target}", "scrape of %s failed: %s", target, e)
             return target, None, time.monotonic() - t0
@@ -772,6 +850,12 @@ class SliceAggregator:
         return {
             "targets": list(self._targets),
             "timeout_s": self._timeout_s,
+            # Round-trace ring occupancy (None = tracing off); the traces
+            # themselves are at GET /debug/trace.
+            "trace": (
+                self._tracer.store.stats() if self._tracer is not None
+                else None
+            ),
             # Per-target parsed-layout sizes: 0 = never parsed (target down
             # since start) OR deliberately uncached (oversize body — see
             # layout_oversize below); steady state ≈ body line count.
@@ -835,6 +919,12 @@ def main(argv: list[str] | None = None) -> int:
                         "(default 0 = auto: max(2x --interval-s, "
                         "--timeout-s))")
     p.add_argument("--breaker-backoff-max-s", type=float, default=120.0)
+    p.add_argument("--trace", default="on", choices=("on", "off"),
+                   help="round tracing: one trace per aggregation round "
+                        "with per-target scrape spans, exported at "
+                        "/debug/trace; the trace context propagates to "
+                        "each exporter via a traceparent header")
+    p.add_argument("--trace-max-traces", type=int, default=256)
     p.add_argument("--history-fallback-window", type=float, default=0.0,
                    help="when a target's scrape fails, query its history "
                         "flight recorder (/api/v1/window_stats) over this "
@@ -868,6 +958,15 @@ def main(argv: list[str] | None = None) -> int:
     if ns.replay_from and targets == ("-",):
         targets = fetch.targets
     store = SnapshotStore()
+    trace_store = tracer = None
+    if ns.trace == "on":
+        from tpu_pod_exporter.trace import Tracer, TraceStore
+
+        # No slow-poll sampler on the aggregator: a slow round is already
+        # attributed by its per-target scrape spans (the scrape pool, not
+        # the round thread, is where the time goes).
+        trace_store = TraceStore(max_traces=ns.trace_max_traces)
+        tracer = Tracer(trace_store, slow_poll_s=0.0, root_name="round")
     breaker_backoff_s = (
         ns.breaker_backoff_s if ns.breaker_backoff_s > 0
         else max(2.0 * ns.interval_s, ns.timeout_s)
@@ -886,6 +985,7 @@ def main(argv: list[str] | None = None) -> int:
         breaker_backoff_s=breaker_backoff_s,
         # The ceiling must admit the base (huge --interval-s setups).
         breaker_backoff_max_s=max(ns.breaker_backoff_max_s, breaker_backoff_s),
+        tracer=tracer,
     )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
@@ -894,6 +994,7 @@ def main(argv: list[str] | None = None) -> int:
         max_scrapes_per_s=ns.max_scrapes_per_s,
         debug_vars=agg.debug_vars,
         debug_addr=ns.debug_addr,
+        trace=trace_store,
     )
 
     stop = threading.Event()

@@ -284,6 +284,23 @@ class ExporterApp:
                 max_series=cfg.history_max_series,
                 retention_s=cfg.history_retention_s,
             )
+        # End-to-end poll tracing (tpu_pod_exporter.trace): per-phase spans
+        # on every poll, a slow-poll stack profiler, and a bounded trace
+        # ring exported at /debug/trace. On by default (--trace off
+        # disables; the collector then runs the exact untraced code path).
+        self.trace = None
+        self.tracer = None
+        if cfg.trace:
+            from tpu_pod_exporter.trace import StackSampler, Tracer, TraceStore
+
+            self.trace = TraceStore(max_traces=cfg.trace_max_traces)
+            self.tracer = Tracer(
+                self.trace,
+                slow_poll_s=cfg.trace_slow_poll_s,
+                sampler=(
+                    StackSampler() if cfg.trace_slow_poll_s > 0 else None
+                ),
+            )
         # Scrape-latency distribution: handler threads observe, the
         # collector emits it into each snapshot (one poll behind, which is
         # fine for a cumulative histogram).
@@ -304,6 +321,7 @@ class ExporterApp:
             scrape_duration_hist=scrape_hist,
             history=self.history,
             supervisors=self.supervisors,
+            tracer=self.tracer,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -319,6 +337,7 @@ class ExporterApp:
             max_scrapes_per_s=cfg.max_scrapes_per_s,
             scrape_observer=scrape_hist.observe,
             history=self.history,
+            trace=self.trace,
             debug_addr=cfg.debug_addr,
             live_fn=self._live_check,
             ready_detail_fn=self._ready_detail,
@@ -373,6 +392,7 @@ class ExporterApp:
             },
             "last_poll": {
                 "ok": stats.ok,
+                "trace_id": stats.trace_id,  # join key into /debug/trace
                 "errors": list(stats.errors),
                 "skipped": list(stats.skipped),
                 "device_read_s": stats.device_read_s,
@@ -396,6 +416,8 @@ class ExporterApp:
             }
         if self.history is not None:
             out["history"] = self.history.stats()
+        if self.trace is not None:
+            out["trace"] = self.trace.stats()
         if self.supervisors:
             out["supervisors"] = {
                 source: sup.stats() for source, sup in self.supervisors.items()
@@ -422,6 +444,8 @@ class ExporterApp:
         self.loop.stop()
         self.server.stop()
         self.collector.close()
+        if self.tracer is not None:
+            self.tracer.close()
 
 
 def main(argv: list[str] | None = None) -> int:

@@ -44,6 +44,8 @@ import re
 import time
 from dataclasses import dataclass, field
 
+from tpu_pod_exporter import trace as trace_mod
+
 log = logging.getLogger("tpu_pod_exporter.chaos")
 
 KINDS = ("hang", "err", "slow", "garbage")
@@ -247,6 +249,17 @@ class ChaosWrapper:
             triggered.fired += 1
             self.injected.append((idx, triggered.kind))
             log.debug("chaos: %s[%d] %s", self.source, idx, triggered.kind)
+            # Annotate the active phase span (the supervisor propagates the
+            # poll's trace context onto its worker threads, so this lands on
+            # the right span even when the injection runs supervised): an
+            # injected wedge must read as a *caused* incident in the trace.
+            detail = ""
+            if triggered.kind in ("hang", "slow"):
+                detail = f" {triggered.effective_duration_s:g}s"
+            trace_mod.annotate(
+                f"chaos: injected {triggered.kind}{detail} "
+                f"(call {idx}, rule {triggered.kind}:{triggered.source})"
+            )
             if triggered.kind in ("hang", "slow"):
                 # Sleep OUTSIDE any inner lock, then proceed with the real
                 # call — a wedged-then-released source returns real data.
@@ -330,6 +343,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--timeout-s", type=float, default=60.0,
                    help="give up if the exporter has not recovered by then")
     p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--trace-out", default="",
+                   help="write the incident's poll traces as Chrome "
+                        "trace_event JSON to this path on exit (CI uploads "
+                        "it as an artifact when the demo fails)")
     ns = p.parse_args(argv)
 
     _utils.setup_logging("warning")
@@ -341,6 +358,10 @@ def main(argv: list[str] | None = None) -> int:
         chaos_spec=f"hang:device:1:{ns.hang_s:g}s:x{ns.hangs}",
         chaos_seed=ns.seed,
         history_retention_s=0.0,
+        # Slow-poll threshold under the deadline, so every wedged poll gets
+        # its stacks sampled — the incident trace then names the hung frame
+        # (chaos._invoke here), not just the abandoned span.
+        trace_slow_poll_s=ns.deadline_s / 2.0,
     )
     app = ExporterApp(cfg)
     app.start()
@@ -384,6 +405,18 @@ def main(argv: list[str] | None = None) -> int:
         else:
             print("TIMEOUT: exporter did not recover", flush=True)
     finally:
+        if ns.trace_out and app.trace is not None:
+            # The abandoned device spans + profiler stacks of the wedge,
+            # viewable in chrome://tracing / Perfetto. Written win or lose —
+            # CI only uploads it when the demo failed.
+            from tpu_pod_exporter.trace import to_chrome_trace
+
+            doc = to_chrome_trace(app.trace.last(app.trace.max_traces),
+                                  app.trace.scrapes(256))
+            with open(ns.trace_out, "w", encoding="utf-8") as f:
+                json.dump(doc, f)
+            print(f"incident trace written to {ns.trace_out} "
+                  f"({len(doc['traceEvents'])} events)")
         app.stop()
     return rc
 

@@ -42,6 +42,7 @@ from tpu_pod_exporter.metrics import (
     SnapshotBuilder,
     SnapshotStore,
 )
+from tpu_pod_exporter import trace as trace_mod
 from tpu_pod_exporter import utils
 from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.registry import PrefixCache
@@ -72,6 +73,9 @@ class PollStats:
     # counter, tpu_exporter_source_calls_skipped_total); same split the
     # aggregator applies to its per-target scrape-error counter.
     skipped: tuple[str, ...] = ()
+    # Trace id of this poll's trace ("" when tracing is off) — the join key
+    # between /debug/vars' last_poll, the JSON log stream, and /debug/trace.
+    trace_id: str = ""
 
 
 class Collector:
@@ -90,6 +94,7 @@ class Collector:
         scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
         history=None,  # HistoryStore fed after each snapshot swap
         supervisors=None,  # {"device"|"attribution"|"process_scan": SourceSupervisor}
+        tracer=None,  # trace.Tracer; None = zero tracing work per poll
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
@@ -105,6 +110,9 @@ class Collector:
         # call runs in-thread exactly as before (tests/bench construct the
         # Collector bare).
         self._supervisors = supervisors or {}
+        # End-to-end poll tracing (tpu_pod_exporter.trace): every poll
+        # becomes a trace with one span per phase; None skips every hook.
+        self._tracer = tracer
         # Consecutive-failure counts per phase error key, for recovery log
         # lines on the UNsupervised path (supervisors log their own).
         self._phase_failures: dict[str, int] = {}
@@ -201,6 +209,11 @@ class Collector:
     # ------------------------------------------------------------------ poll
 
     def poll_once(self) -> PollStats:
+        # One trace per poll (tpu_pod_exporter.trace): the root span also
+        # arms the slow-poll stack sampler, and setting the thread-local
+        # context here is what stamps trace ids onto every log line below.
+        tracer = self._tracer
+        tr = tracer.start_poll() if tracer is not None else None
         t0 = self._clock()
         errors: list[str] = []
         skips: list[str] = []
@@ -210,6 +223,10 @@ class Collector:
         # fenced worker with a hard deadline, behind the source's breaker.
         td0 = self._clock()
         sup = self._supervisors.get("device")
+        if tr is not None:
+            tr.begin("device_read",
+                     breaker=sup.breaker.state if sup is not None else "")
+        dev_status = "ok"
         host_sample: HostSample | None = None
         try:
             host_sample = sup.call() if sup is not None else self._backend.sample()
@@ -222,23 +239,30 @@ class Collector:
             # degrades like an error (stale/absent data is the truth), but
             # it is neither counted as a poll error nor logged past INFO:
             # the fault already logged when the breaker opened.
+            dev_status = "skipped"
             skips.append("device_read")
             self._rlog.info("device_skip", "device read skipped: %s", e)
         except SourceTimeout as e:
+            dev_status = "abandoned"
             errors.append("device_read")
             self._rlog.warning("device_timeout", "device read abandoned: %s", e)
         except BackendError as e:
+            dev_status = "err"
             errors.append("device_read")
             self._count_phase_failure("device_read", sup)
             self._rlog.warning("device_read", "device read failed: %s", e)
         except Exception as e:  # noqa: BLE001 — never die in the loop
+            dev_status = "err"
             errors.append("device_read")
             self._count_phase_failure("device_read", sup)
             self._rlog.error("device_read_unexpected", "device read failed unexpectedly: %s", e, exc_info=True)
         td1 = self._clock()
+        if tr is not None:
+            tr.end(dev_status,
+                   chips=len(host_sample.chips) if host_sample is not None else 0)
 
         # Phase 2: attribution (replaces main.go:74-114).
-        attr = self._read_attribution(errors, skips)
+        attr = self._read_attribution(errors, skips, tr)
         ta1 = self._clock()
 
         # Phase 2b: process scan (the honest analog of the reference's PID
@@ -246,6 +270,10 @@ class Collector:
         holders = None
         if self._process_scanner is not None:
             psup = self._supervisors.get("process_scan")
+            if tr is not None:
+                tr.begin("process_scan",
+                         breaker=psup.breaker.state if psup is not None else "")
+            scan_status = "ok"
             try:
                 holders = (
                     psup.call() if psup is not None
@@ -256,12 +284,15 @@ class Collector:
                 self._last_holders_at = self._clock()
             except Exception as e:  # noqa: BLE001 — never die in the loop
                 if isinstance(e, SourceSkipped):
+                    scan_status = "skipped"
                     skips.append("process_scan")
                     self._rlog.info("process_scan_skip", "process scan skipped: %s", e)
                 elif isinstance(e, SourceTimeout):
+                    scan_status = "abandoned"
                     errors.append("process_scan")
                     self._rlog.warning("process_scan_timeout", "process scan abandoned: %s", e)
                 else:
+                    scan_status = "err"
                     errors.append("process_scan")
                     self._count_phase_failure("process_scan", psup)
                     self._rlog.warning("process_scan", "process scan failed: %s", e)
@@ -271,9 +302,14 @@ class Collector:
                     <= self._attribution_max_stale_s
                 ):
                     holders = self._last_holders
+            if tr is not None:
+                tr.end(scan_status,
+                       holders=len(holders) if holders is not None else 0)
         tps1 = self._clock()
 
         # Phase 3: join (replaces main.go:141-154).
+        if tr is not None:
+            tr.begin("join")
         device_owner = attr.by_device_id(self._resource_name) if attr else {}
         allocatable = attr.allocatable_device_ids if attr else None
         # None ⇒ "source cannot report"; 0 is a real, publishable value on an
@@ -286,8 +322,10 @@ class Collector:
             else None
         )
         tj1 = self._clock()
+        if tr is not None:
+            tr.end("ok", owned_devices=len(device_owner))
 
-        # Phase 4: publish.
+        # Phase 4: publish (snapshot build + swap).
         stats = PollStats(
             device_read_s=td1 - td0,
             attribution_s=ta1 - td1,
@@ -298,11 +336,16 @@ class Collector:
             ok="device_read" not in errors and "device_read" not in skips,
             errors=tuple(errors),
             skipped=tuple(skips),
+            trace_id=tr.trace_id if tr is not None else "",
         )
+        if tr is not None:
+            tr.begin("publish")
         snap = self._publish(host_sample, device_owner, stats, now_mono=tj1,
                              allocatable=allocatable, allocated=allocated,
                              holders=holders)
         tp1 = self._clock()
+        if tr is not None:
+            tr.end("ok", series=snap.series_count)
         stats.publish_s = tp1 - tj1
         stats.total_s = tp1 - t0
         self.last_stats = stats
@@ -323,49 +366,95 @@ class Collector:
         # phase distributions it is separately accounted against
         # (tpu_exporter_history_append_seconds).
         if self._history is not None:
+            if tr is not None:
+                tr.begin("history_append")
             th0 = self._clock()
+            appended = 0
+            hist_status = "ok"
             try:
-                self._history.append_snapshot(snap, now_mono=th0,
-                                              now_wall=snap.timestamp)
+                appended = self._history.append_snapshot(
+                    snap, now_mono=th0, now_wall=snap.timestamp
+                )
             except Exception as e:  # noqa: BLE001 — recording must not fail a poll
+                hist_status = "err"
                 self._rlog.error(
                     "history_append", "history append failed: %s", e,
                     exc_info=True,
                 )
             self._history_append_s = self._clock() - th0
+            if tr is not None:
+                tr.end(hist_status, samples=appended)
+            # The append IS part of the poll's latency story even though it
+            # is excluded from publish/total: give it its own distribution
+            # label so the per-phase heatmap shows where post-swap time goes.
+            self._phase_hist.observe(self._history_append_s, ("history_append",))
+        if tr is not None:
+            tracer.finish(tr, status="ok" if stats.ok else "err",
+                          errors=len(errors), skips=len(skips))
+            if tr.slow:
+                # Trace-correlated breadcrumb for the incident timeline; the
+                # profile itself lives in /debug/trace, not in the logs.
+                # Logs the ROOT SPAN duration — the number the slow
+                # classification actually compared (it includes the
+                # history append, which stats.total_s deliberately
+                # excludes; printing total_s here could contradict the
+                # budget the line claims was exceeded).
+                self._rlog.warning(
+                    "slow_poll",
+                    "slow poll: %.3fs > %.3gs budget (trace %s, %d profile "
+                    "samples — GET /debug/trace)",
+                    tr.root.dur_s, tracer.slow_poll_s, tr.trace_id,
+                    tr.profile_samples,
+                )
         return stats
 
-    def _read_attribution(self, errors: list[str],
-                          skips: list[str]) -> AttributionSnapshot | None:
+    def _read_attribution(self, errors: list[str], skips: list[str],
+                          tr=None) -> AttributionSnapshot | None:
         now = self._clock()
         sup = self._supervisors.get("attribution")
+        if tr is not None:
+            tr.begin("attribution",
+                     breaker=sup.breaker.state if sup is not None else "")
+        status = "ok"
+        snap = None
         try:
             snap = sup.call() if sup is not None else self._attribution.snapshot()
             self._phase_recovered("attribution", supervised=sup is not None)
             self._last_attr = snap
             self._last_attr_at = now
-            return snap
         except SourceSkipped as e:
+            status = "skipped"
             skips.append("attribution")
             self._rlog.info("attribution_skip", "attribution read skipped: %s", e)
         except SourceTimeout as e:
+            status = "abandoned"
             errors.append("attribution")
             self._rlog.warning("attribution_timeout", "attribution read abandoned: %s", e)
         except AttributionError as e:
+            status = "err"
             errors.append("attribution")
             self._count_phase_failure("attribution", sup)
             self._rlog.warning("attribution", "attribution read failed: %s", e)
         except Exception as e:  # noqa: BLE001
+            status = "err"
             errors.append("attribution")
             self._count_phase_failure("attribution", sup)
             self._rlog.error("attribution_unexpected", "attribution failed unexpectedly: %s", e, exc_info=True)
-        # Bounded-staleness reuse of the last good snapshot.
-        if (
+        if snap is None and (
             self._last_attr is not None
             and now - self._last_attr_at <= self._attribution_max_stale_s
         ):
-            return self._last_attr
-        return None
+            # Bounded-staleness reuse of the last good snapshot.
+            snap = self._last_attr
+            if tr is not None:
+                trace_mod.annotate(
+                    f"reusing attribution snapshot from "
+                    f"{now - self._last_attr_at:.1f}s ago (bounded staleness)"
+                )
+        if tr is not None:
+            tr.end(status,
+                   allocations=len(snap.allocations) if snap is not None else 0)
+        return snap
 
     # ------------------------------------------------- phase fault tracking
 
@@ -665,6 +754,15 @@ class Collector:
                   float(st["skipped"]), (source,))
             b.add(schema.TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL,
                   float(st["reconnects"]), (source,))
+
+        # Tracing surface: slow-poll count + ring occupancy. Read one poll
+        # behind (this publish runs before the current trace finishes) —
+        # the same lag every other point-in-time self-metric carries.
+        if self._tracer is not None:
+            slow, traces, spans = self._tracer.store.counts()
+            b.add(schema.TPU_EXPORTER_SLOW_POLLS_TOTAL, float(slow))
+            b.add(schema.TPU_EXPORTER_TRACES, float(traces))
+            b.add(schema.TPU_EXPORTER_TRACE_SPANS, float(spans))
 
         polls = self._counters.inc(schema.TPU_EXPORTER_POLLS_TOTAL.name, ())
         b.add(schema.TPU_EXPORTER_POLLS_TOTAL, polls)

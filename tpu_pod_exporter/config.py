@@ -66,6 +66,21 @@ class ExporterConfig:
     # (spec, chaos_seed).
     chaos_spec: str = ""
     chaos_seed: int = 0
+    # End-to-end poll tracing (tpu_pod_exporter.trace): every poll becomes
+    # a trace with one span per phase, retained in a bounded in-memory ring
+    # and exported as Chrome trace_event JSON via GET /debug/trace
+    # (loopback-only by default, like every /debug/* route). On by default —
+    # the measured poll-loop overhead budget is <5% (make trace-overhead);
+    # --trace off restores the untraced poll path exactly.
+    trace: bool = True
+    # Slow-poll profiler: a poll running past this many seconds gets its
+    # poll thread's (and any supervised worker's) Python stack sampled at
+    # ~50 Hz for the remainder of the poll; the collapsed stacks attach to
+    # the trace. 0 disables the profiler (spans still recorded).
+    trace_slow_poll_s: float = 1.0
+    # Bounded trace ring: oldest trace evicted past this many (same
+    # hard-bound discipline as --history-max-series).
+    trace_max_traces: int = 256
     # /metrics concurrency cap: excess scrapers queue briefly then get 429
     # (0 disables). Protects the TPU host's cores from scrape storms.
     max_concurrent_scrapes: int = 4
@@ -118,7 +133,7 @@ class ExporterConfig:
         if raw is None:
             return fallback
         if isinstance(fallback, bool):
-            return raw.lower() in ("1", "true", "yes")
+            return raw.lower() in ("1", "true", "yes", "on")
         if isinstance(fallback, int):
             return int(raw)
         if isinstance(fallback, float):
@@ -142,9 +157,9 @@ class ExporterConfig:
                 # as False.
                 def parse_bool(s: str) -> bool:
                     low = s.lower()
-                    if low in ("1", "true", "yes"):
+                    if low in ("1", "true", "yes", "on"):
                         return True
-                    if low in ("0", "false", "no"):
+                    if low in ("0", "false", "no", "off"):
                         return False
                     raise argparse.ArgumentTypeError(
                         f"expected true/false, got {s!r}"

@@ -58,10 +58,13 @@ from tpu_pod_exporter.metrics import schema
 # Metric families the collector feeds into history each poll. Info series
 # (tpu_host_info, tpu_exporter_info) and self-metrics are excluded — their
 # history is either constant or recoverable from counters — EXCEPT
-# tpu_chip_info and tpu_exporter_up: chip_info is the guaranteed per-chip
-# presence series (HBM may be unreadable), so "which chips existed at time
-# T" must come from it, and exporter_up is the first question of any
-# incident timeline.
+# tpu_chip_info, tpu_exporter_up and tpu_exporter_slow_polls_total:
+# chip_info is the guaranteed per-chip presence series (HBM may be
+# unreadable), so "which chips existed at time T" must come from it;
+# exporter_up is the first question of any incident timeline — and slow
+# polls are the second ("was the exporter itself struggling?"), so the
+# tracing counter rides along and window_stats' counter-aware rate answers
+# "slow polls in the last N minutes" without a Prometheus.
 HISTORY_TRACKED_METRICS: frozenset[str] = frozenset({
     "tpu_hbm_used_bytes",
     "tpu_hbm_total_bytes",
@@ -78,6 +81,7 @@ HISTORY_TRACKED_METRICS: frozenset[str] = frozenset({
     "tpu_kubelet_allocatable_chips",
     "tpu_kubelet_allocated_chips",
     "tpu_exporter_up",
+    "tpu_exporter_slow_polls_total",
 })
 
 _SPEC_BY_NAME = {spec.name: spec for spec in schema.ALL_SPECS}

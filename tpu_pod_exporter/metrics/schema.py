@@ -333,6 +333,29 @@ TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL = MetricSpec(
     label_names=("source",),
 )
 
+# --- Poll tracing (tpu_pod_exporter.trace) -----------------------------------
+# Declared unconditionally (stable surface); samples appear only while
+# tracing is enabled (--trace, the default) — same conditional-sample rule
+# as the supervision series above.
+
+TPU_EXPORTER_SLOW_POLLS_TOTAL = MetricSpec(
+    name="tpu_exporter_slow_polls_total",
+    help="Polls whose total duration exceeded --trace-slow-poll-s; each carries a sampled stack profile in its trace (GET /debug/trace, loopback-only by default).",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_TRACES = MetricSpec(
+    name="tpu_exporter_traces",
+    help="Poll traces currently retained in the bounded in-memory trace ring (--trace-max-traces).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_TRACE_SPANS = MetricSpec(
+    name="tpu_exporter_trace_spans",
+    help="Spans retained across all traces in the ring — the /debug/trace export size driver.",
+    type=GAUGE,
+)
+
 TPU_EXPORTER_INFO = MetricSpec(
     name="tpu_exporter_info",
     help="Static exporter build/runtime info; value is always 1.",
@@ -438,6 +461,9 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_SOURCE_CALLS_ABANDONED_TOTAL,
     TPU_EXPORTER_SOURCE_CALLS_SKIPPED_TOTAL,
     TPU_EXPORTER_SOURCE_RECONNECTS_TOTAL,
+    TPU_EXPORTER_SLOW_POLLS_TOTAL,
+    TPU_EXPORTER_TRACES,
+    TPU_EXPORTER_TRACE_SPANS,
     TPU_EXPORTER_INFO,
 )
 

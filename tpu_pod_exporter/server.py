@@ -16,8 +16,9 @@ Additional endpoints the reference lacks:
   (``tpu_pod_exporter.history``); served on the metrics port because the
   slice aggregator consumes them. Absent history (``--history-retention-s
   0``) answers 404 JSON.
-- ``/debug/vars`` and ``/debug/stacks`` answer **loopback clients only** by
-  default (thread stacks and config are operator surface, not fleet
+- ``/debug/vars``, ``/debug/stacks`` and ``/debug/trace`` (poll traces as
+  Chrome ``trace_event`` JSON) answer **loopback clients only** by default
+  (thread stacks, config and traces are operator surface, not fleet
   surface); ``--debug-addr 0.0.0.0`` restores remote access.
 
 The server is a stdlib ThreadingHTTPServer: no event-loop dependency, a few
@@ -36,6 +37,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
 from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.trace import parse_traceparent, to_chrome_trace
 
 
 def _json_sanitize(obj):
@@ -192,6 +194,11 @@ class _Handler(BaseHTTPRequestHandler):
     debug_vars = None  # optional callable -> dict
     # Optional HistoryStore serving /api/v1/*; None = history disabled.
     history = None
+    # Optional trace.TraceStore: serves GET /debug/trace (Chrome
+    # trace_event JSON) and records a node-side scrape span whenever a
+    # /metrics request carries a traceparent header (the aggregator's
+    # fan-out propagation). None = tracing disabled (--trace off).
+    trace = None
     # Concurrency fence for /api/v1/*: queries copy ring contents (cheap,
     # but not free at 256-chip scale) and ThreadingHTTPServer spawns a
     # thread per request — without a cap, a flood of history queries could
@@ -268,6 +275,17 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif path == "/debug/trace":
+            # Poll traces as Chrome trace_event JSON (chrome://tracing /
+            # Perfetto). Loopback-gated by the /debug/* guard above.
+            # Lock discipline (satellite audit, all /debug/* + /api/v1
+            # routes): every store-backed route copies references/values
+            # under the store's lock and serializes OUTSIDE it —
+            # TraceStore.last/scrapes here, _rows_for for /api/v1, the
+            # debug_vars callable's stats() snapshots — so a slow client
+            # draining a large JSON body can never hold a lock the poll
+            # thread needs for its snapshot swap or history/trace append.
+            self._serve_trace(query)
         elif path == "/debug/stacks":
             # The pprof-equivalent SURVEY §5 asks for, sized to this
             # process: a point-in-time dump of every thread's Python stack.
@@ -321,6 +339,41 @@ class _Handler(BaseHTTPRequestHandler):
             )
         else:
             self._serve_text(404, b"not found\n")
+
+    # --------------------------------------------------------- trace export
+
+    # /debug/trace response bound: `last` is clamped so the export stays a
+    # bounded handful of MB no matter what a client asks for (each trace is
+    # ~8 spans; scrape spans are capped by their own ring).
+    TRACE_EXPORT_MAX_LAST = 200
+
+    def _serve_trace(self, query: str) -> None:
+        ts = self.trace
+        if ts is None:
+            self._serve_json(404, {
+                "status": "error",
+                "error": "tracing disabled (--trace off)",
+            })
+            return
+        qs = parse_qs(query, keep_blank_values=True)
+        try:
+            last = int((qs.get("last") or ["20"])[-1])
+        except ValueError:
+            self._serve_json(400, {
+                "status": "error", "error": "last must be an integer",
+            })
+            return
+        if last < 1:
+            self._serve_json(400, {
+                "status": "error", "error": "last must be >= 1",
+            })
+            return
+        last = min(last, self.TRACE_EXPORT_MAX_LAST)
+        # Copy references under the store lock; build + serialize the (much
+        # larger) JSON document outside it (see the /debug/* lock audit).
+        traces = ts.last(last)
+        scrapes = ts.scrapes(min(4 * last, 512))
+        self._serve_json(200, to_chrome_trace(traces, scrapes))
 
     # ------------------------------------------------------- history queries
 
@@ -458,9 +511,24 @@ class _Handler(BaseHTTPRequestHandler):
         try:
             t0 = time.perf_counter()
             self._serve_metrics_inner()
+            dur = time.perf_counter() - t0
             observer = self.scrape_observer
             if observer is not None:
-                observer(time.perf_counter() - t0)
+                observer(dur)
+            tstore = self.trace
+            if tstore is not None:
+                # Cross-tier join: a scrape carrying a W3C traceparent
+                # header (the aggregator stamps one per fan-out scrape)
+                # records a node-side scrape span under the REMOTE trace
+                # context, so the aggregator's round trace links to this
+                # exporter's serve time. Headerless scrapes (Prometheus)
+                # record nothing — no per-scrape ring churn.
+                ctx = parse_traceparent(self.headers.get("traceparent") or "")
+                if ctx is not None:
+                    tstore.record_scrape(
+                        ctx[0], ctx[1], time.time() - dur, dur,
+                        client=self.client_address[0],
+                    )
         finally:
             if sem is not None:
                 sem.release()
@@ -543,6 +611,7 @@ class MetricsServer:
         scrape_tarpit_s: float = 0.1,
         scrape_observer=None,
         history=None,
+        trace=None,
         debug_addr: str = "127.0.0.1",
         live_fn=None,
         ready_detail_fn=None,
@@ -557,6 +626,7 @@ class MetricsServer:
                 "store": store,
                 "debug_vars": staticmethod(debug_vars) if debug_vars else None,
                 "history": history,
+                "trace": trace,
                 "api_sem": (
                     threading.BoundedSemaphore(2) if history is not None else None
                 ),

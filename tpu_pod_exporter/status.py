@@ -113,13 +113,39 @@ def _fmt_delta_bytes(d: float) -> str:
     return ("+" if d >= 0 else "-") + fmt_bytes(abs(d))
 
 
+# Series name the watch-mode phase breakdown stores its timings under — the
+# same family the exporter's per-phase histogram publishes, so the footer
+# reads as a local preview of the daemon's phase heatmap.
+PHASE_METRIC = "tpu_exporter_poll_phase_duration_seconds"
+
+
+def phase_breakdown_line(history, phases, window_s: float) -> str | None:
+    """``phases: device_read 1.2ms (p≈mean 1.1ms) · …`` footer for watch
+    mode, computed from the locally-recorded phase series over the trailing
+    window. None until at least one phase has a sample."""
+    parts = []
+    for phase in phases:
+        rows = history.window_stats(PHASE_METRIC, {"phase": phase},
+                                    window_s=window_s)
+        if not rows:
+            continue
+        s = rows[0]["stats"]
+        parts.append(
+            f"{phase} {1e3 * s['last']:.1f}ms"
+            f" (mean {1e3 * s['mean']:.1f}ms, max {1e3 * s['max']:.1f}ms)"
+        )
+    return "phases: " + " · ".join(parts) if parts else None
+
+
 def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
          history=None, trend_window_s=0.0) -> int:
+    t_phase0 = time.perf_counter()
     try:
         sample = backend.sample()
     except BackendError as e:
         print(f"device read failed: {e}", file=sys.stderr)
         return 1
+    t_phase1 = time.perf_counter()
     # Per-chip read problems must be visible even when they leave 0 chips —
     # "no chips found" and "all chip reads failed" are different diagnoses.
     for err in sample.partial_errors:
@@ -129,6 +155,17 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
     except AttributionError as e:
         print(f"(attribution unavailable: {e})", file=sys.stderr)
         owner_map = {}
+    t_phase2 = time.perf_counter()
+    if history is not None:
+        # Watch mode keeps a local per-phase latency record (the same
+        # series name as the exporter's phase histogram) so the footer
+        # shows where each refresh's time goes — a hung attribution source
+        # is visible as a growing phase cell before it is visible anywhere
+        # else on a box with no daemon running.
+        history.append(PHASE_METRIC, {"phase": "device_read"},
+                       t_phase1 - t_phase0)
+        history.append(PHASE_METRIC, {"phase": "attribution"},
+                       t_phase2 - t_phase1)
 
     if not as_json and topo.accelerator:
         st = topo.slice_topology
@@ -147,11 +184,15 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
 
     holders_by_path: dict[str, list] = {}
     if scanner is not None:
+        t_scan0 = time.perf_counter()
         try:
             for h in scanner.scan():
                 holders_by_path.setdefault(h.device_path, []).append(h)
         except Exception as e:  # noqa: BLE001 — status stays useful without it
             print(f"(process scan unavailable: {e})", file=sys.stderr)
+        if history is not None:
+            history.append(PHASE_METRIC, {"phase": "process_scan"},
+                           time.perf_counter() - t_scan0)
 
     rows = []
     doc_chips = []
@@ -277,6 +318,15 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
             for (ns, pod), (n, hbm) in sorted(pods.items())
         ]
         print(render_table(pod_rows, ["pod", "chips", "hbm used"]))
+
+    if history is not None:
+        phases = ["device_read", "attribution"]
+        if scanner is not None:
+            phases.append("process_scan")
+        line = phase_breakdown_line(history, phases, trend_window_s)
+        if line:
+            print()
+            print(line)
     return 0
 
 

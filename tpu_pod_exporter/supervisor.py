@@ -34,6 +34,7 @@ import random
 import threading
 import time
 
+from tpu_pod_exporter import trace as trace_mod
 from tpu_pod_exporter.utils import RateLimitedLogger
 
 log = logging.getLogger("tpu_pod_exporter.supervisor")
@@ -264,11 +265,23 @@ class SourceSupervisor:
         decision = self.breaker.decide()
         if decision == "skip":
             self.skipped += 1
+            # Span annotation (no-op outside a traced poll): the quarantine
+            # decision is part of the poll's causal story.
+            trace_mod.annotate(
+                f"breaker open: call skipped, next probe in "
+                f"{self.breaker.seconds_until_probe:.1f}s"
+            )
             raise SourceSkipped(
                 f"{self.source}: breaker open, next probe in "
                 f"{self.breaker.seconds_until_probe:.1f}s"
             )
         fn = self._fn
+        if decision == "probe":
+            trace_mod.annotate(
+                "half-open probe"
+                + (": reconnect + single call" if self._reconnect is not None
+                   else "")
+            )
         if decision == "probe" and self._reconnect is not None:
             # Reconnect ON the worker thread: close() of a wedged channel
             # may itself block, and that must be abandonable too. The
@@ -298,10 +311,29 @@ class SourceSupervisor:
             # thread into the same wedge buys nothing and leaks a thread;
             # fail the phase immediately instead (counts as a failure, so
             # the breaker keeps backing off).
+            trace_mod.annotate(
+                f"{len(self._fenced)} abandoned workers still blocked; "
+                f"call refused without spawning another"
+            )
             raise SourceTimeout(
                 f"{self.source}: {len(self._fenced)} abandoned calls still "
                 f"blocked; refusing to spawn more workers"
             )
+        # Carry the poll thread's trace context onto the worker: the call
+        # body (and anything it triggers — chaos injections, provider logs)
+        # annotates the PHASE span, not limbo. Restored in a finally so a
+        # reused worker never leaks one poll's span into the next.
+        span = trace_mod.current_span()
+        if span is not None:
+            inner = fn
+
+            def fn():
+                prev = trace_mod.swap_current(span)
+                try:
+                    return inner()
+                finally:
+                    trace_mod.swap_current(prev)
+
         w = self._worker
         if w is None or not w.thread.is_alive():
             w = self._worker = _Worker(self.source)
@@ -322,6 +354,10 @@ class SourceSupervisor:
             self._worker = None
             self._fenced.append(w)
             self.abandoned += 1
+            trace_mod.annotate(
+                f"deadline {self.deadline_s:g}s exceeded; worker "
+                f"{w.thread.name} fenced ({len(self._fenced)} abandoned alive)"
+            )
             raise SourceTimeout(
                 f"{self.source}: call exceeded {self.deadline_s:g}s phase "
                 f"deadline; worker abandoned"

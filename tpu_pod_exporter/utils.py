@@ -12,6 +12,13 @@ from __future__ import annotations
 import logging
 import time
 
+from tpu_pod_exporter import trace as trace_mod
+
+# Per-key cap on distinct trace ids tracked while suppressing: the tally is
+# a correlation hint, not a full index — one poll per second over a 30 s
+# window is ≤30 traces, and a flapping key must not grow an unbounded map.
+_MAX_TRACES_PER_KEY = 32
+
 
 class RateLimitedLogger:
     def __init__(
@@ -24,23 +31,47 @@ class RateLimitedLogger:
         self._min_interval_s = min_interval_s
         self._clock = clock
         self._last_emit: dict[str, float] = {}
-        # key -> (count, last suppression time); counts expire with the
-        # window so an old incident's tally is never attributed to a new one.
-        self._suppressed: dict[str, tuple[int, float]] = {}
+        # key -> (count, last suppression time, {trace_id: count}); counts
+        # expire with the window so an old incident's tally is never
+        # attributed to a new one. The per-trace sub-counts let the next
+        # emission say how many suppressed lines belonged to the trace
+        # that is active WHEN it finally emits — the line an operator uses
+        # to jump from the log stream into /debug/trace.
+        self._suppressed: dict[str, tuple[int, float, dict]] = {}
 
     def _emit(self, level: int, key: str, msg: str, *args, **kwargs) -> None:
         now = self._clock()
         last = self._last_emit.get(key)
         if last is not None and now - last < self._min_interval_s:
-            count, _ = self._suppressed.get(key, (0, now))
-            self._suppressed[key] = (count + 1, now)
+            count, _, traces = self._suppressed.get(key, (0, now, {}))
+            tid = trace_mod.current_ids()[0]
+            if tid is not None and (
+                tid in traces or len(traces) < _MAX_TRACES_PER_KEY
+            ):
+                traces[tid] = traces.get(tid, 0) + 1
+            self._suppressed[key] = (count + 1, now, traces)
             return
-        dropped, dropped_at = self._suppressed.pop(key, (0, 0.0))
+        dropped, dropped_at, traces = self._suppressed.pop(key, (0, 0.0, {}))
         # Report a tally only if the suppressed burst is recent (within two
         # windows) — a count left over from an incident days ago must not be
         # attributed to a new, unrelated fault.
         if dropped and now - dropped_at <= 2 * self._min_interval_s:
-            msg = f"{msg} (+{dropped} similar suppressed)"
+            # Trace breakdown of the suppressed burst: prefer the CURRENT
+            # trace when it suppressed any lines (intra-poll bursts), else
+            # the trace that suppressed the most — at one poll per second
+            # the window spans ~30 traces, and the emitting poll's fresh
+            # trace is almost never the one that did the suppressing, so
+            # current-trace-only would report nothing exactly when the
+            # operator needs a /debug/trace join key.
+            tid = trace_mod.current_ids()[0]
+            in_trace = traces.get(tid, 0) if tid is not None else 0
+            if not in_trace and traces:
+                tid, in_trace = max(traces.items(), key=lambda kv: kv[1])
+            if in_trace:
+                msg = (f"{msg} (+{dropped} similar suppressed, "
+                       f"{in_trace} in trace {tid[:8]})")
+            else:
+                msg = f"{msg} (+{dropped} similar suppressed)"
         self._last_emit[key] = now
         self._logger.log(level, msg, *args, **kwargs)
 
@@ -141,6 +172,16 @@ class JsonLogFormatter(logging.Formatter):
             "logger": record.name,
             "message": record.getMessage(),
         }
+        # Trace correlation: a line emitted inside a poll (collector,
+        # supervisor, chaos — including supervised worker threads, which
+        # inherit the poll's context) carries the active trace/span ids, so
+        # `jq 'select(.trace_id == "…")'` reconstructs one poll's log
+        # slice and joins it to /debug/trace. Formatting runs synchronously
+        # on the emitting thread, so the thread-local context is the line's.
+        trace_id, span_id = trace_mod.current_ids()
+        if trace_id is not None:
+            out["trace_id"] = trace_id
+            out["span_id"] = span_id
         if record.exc_info:
             out["exception"] = self.formatException(record.exc_info)
         return json.dumps(out, ensure_ascii=False, default=str)
