@@ -670,3 +670,57 @@ class TestDebugStacks:
         # The handler thread serving this very request is live too.
         assert text.count("--- thread ") >= 1
         assert f"({threading.main_thread().name})" in text
+
+
+class TestClientWriteTimeout:
+    """Slow-client write defense (--client-write-timeout-s): a scraper that
+    stops reading mid-body must not pin a handler thread — the blocked
+    send times out (SO_SNDTIMEO), the connection drops, and the drop is
+    counted for tpu_exporter_client_write_timeouts_total."""
+
+    def test_stalled_reader_is_dropped_and_counted(self):
+        import socket
+        import time
+
+        from tpu_pod_exporter.persist import RestoredSnapshot
+
+        store = SnapshotStore()
+        # A body far larger than the kernel's socket buffers, so the
+        # server-side sendall() genuinely blocks on the stalled client.
+        big = RestoredSnapshot(b"x 1\n" * (16 << 20 >> 2), time.time())
+        store.swap(big)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, client_write_timeout_s=0.5
+        )
+        server.start()
+        try:
+            c = socket.socket()
+            c.setsockopt(socket.SOL_SOCKET, socket.SO_RCVBUF, 4096)
+            c.connect(("127.0.0.1", server.port))
+            c.sendall(b"GET /metrics HTTP/1.1\r\nHost: x\r\n\r\n")
+            # read nothing: the handler's send must block, then time out
+            deadline = time.monotonic() + 10
+            while (
+                server.write_timeouts["total"] == 0
+                and time.monotonic() < deadline
+            ):
+                time.sleep(0.05)
+            assert server.write_timeouts["total"] == 1
+            c.close()
+        finally:
+            server.stop()
+
+    def test_fast_reader_unaffected(self):
+        store = SnapshotStore()
+        put_snapshot(store, 7)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, client_write_timeout_s=0.5
+        )
+        server.start()
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            status, _, body = get(base + "/metrics")
+            assert status == 200 and b"test_metric 7\n" in body
+            assert server.write_timeouts["total"] == 0
+        finally:
+            server.stop()

@@ -285,6 +285,7 @@ class SliceAggregator:
         breaker_backoff_s: float = 10.0,
         breaker_backoff_max_s: float = 120.0,
         tracer=None,
+        breaker_store=None,  # persist.BreakerStateFile; None = no persistence
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
@@ -325,6 +326,14 @@ class SliceAggregator:
         # dead endpoint). breaker_failures=0 disables (every target scraped
         # every round, the pre-breaker behaviour).
         self._breakers: dict[str, CircuitBreaker] | None = None
+        # Restart survivability (tpu_pod_exporter.persist): quarantine
+        # state is restored at boot — a restarted aggregator must not
+        # re-learn every black-holed target from closed, burning
+        # targets × timeout_s per round until the breakers re-open — and
+        # saved whenever any breaker changes state (atomic JSON, tolerant
+        # load; a corrupt file just means fresh breakers).
+        self._breaker_store = breaker_store
+        self._breaker_sigs: dict[str, tuple] = {}
         if breaker_failures > 0:
             self._breakers = {
                 t: CircuitBreaker(
@@ -334,6 +343,23 @@ class SliceAggregator:
                 )
                 for t in targets
             }
+            if breaker_store is not None:
+                saved = breaker_store.load()
+                for t, br in self._breakers.items():
+                    doc = saved.get(t)
+                    if doc:
+                        try:
+                            br.restore_state(doc, wallclock=wallclock)
+                        except Exception as e:  # noqa: BLE001 — never refuse to start
+                            log.warning("breaker restore for %s failed: %s",
+                                        t, e)
+                    if br.state != CLOSED:
+                        log.warning(
+                            "target %s restored %s (reopens=%d, next probe "
+                            "in %.1fs) — quarantine carried across restart",
+                            t, br.state, br.reopens, br.seconds_until_probe,
+                        )
+                    self._breaker_sigs[t] = (br.state, br.reopens)
         self._wallclock = wallclock
         self._counters = CounterStore()
         self._rlog = RateLimitedLogger(log)
@@ -460,6 +486,11 @@ class SliceAggregator:
                 targets=len(self._targets), ok=ok_n,
                 quarantined=len(quarantined), fallbacks=len(fallbacks),
             )
+        # AFTER the round's spans close: the save fsyncs twice, and disk
+        # latency during an incident must not read as publish/round time —
+        # the same persist-outside-the-timings discipline the exporter's
+        # poll applies.
+        self._maybe_save_breakers()
 
     def _history_fallback(self, target: str) -> list | None:
         """Last-known chip data from a down target's flight recorder, as
@@ -889,7 +920,30 @@ class SliceAggregator:
             ),
         }
 
+    def _maybe_save_breakers(self, force: bool = False) -> None:
+        """Persist target breaker state after rounds where any breaker
+        changed state/reopen count (transitions, not per-round churn — the
+        file is rewritten a handful of times per incident, not 1 Hz)."""
+        if self._breaker_store is None or self._breakers is None:
+            return
+        changed = force
+        for t, br in self._breakers.items():
+            sig = (br.state, br.reopens)
+            if self._breaker_sigs.get(t) != sig:
+                self._breaker_sigs[t] = sig
+                changed = True
+        if changed:
+            try:
+                self._breaker_store.save({
+                    t: br.export_state(wallclock=self._wallclock)
+                    for t, br in self._breakers.items()
+                })
+            except Exception as e:  # noqa: BLE001 — persistence must not fail rounds
+                self._rlog.warning("breaker_save",
+                                   "breaker state save failed: %s", e)
+
     def close(self) -> None:
+        self._maybe_save_breakers(force=True)
         self._pool.shutdown(wait=False)
 
 
@@ -919,6 +973,11 @@ def main(argv: list[str] | None = None) -> int:
                         "(default 0 = auto: max(2x --interval-s, "
                         "--timeout-s))")
     p.add_argument("--breaker-backoff-max-s", type=float, default=120.0)
+    p.add_argument("--state-dir", default="",
+                   help="persist per-target breaker state here (atomic "
+                        "JSON) so a restarted aggregator keeps its "
+                        "quarantines instead of re-learning every dead "
+                        "target from closed; empty disables")
     p.add_argument("--trace", default="on", choices=("on", "off"),
                    help="round tracing: one trace per aggregation round "
                         "with per-target scrape spans, exported at "
@@ -971,6 +1030,15 @@ def main(argv: list[str] | None = None) -> int:
         ns.breaker_backoff_s if ns.breaker_backoff_s > 0
         else max(2.0 * ns.interval_s, ns.timeout_s)
     )
+    breaker_store = None
+    if ns.state_dir:
+        import os
+
+        from tpu_pod_exporter.persist import BreakerStateFile
+
+        breaker_store = BreakerStateFile(
+            os.path.join(ns.state_dir, "aggregator-breakers.json")
+        )
     agg = SliceAggregator(
         targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder,
         # Late-bound closure (the loop is constructed just below; the
@@ -986,6 +1054,7 @@ def main(argv: list[str] | None = None) -> int:
         # The ceiling must admit the base (huge --interval-s setups).
         breaker_backoff_max_s=max(ns.breaker_backoff_max_s, breaker_backoff_s),
         tracer=tracer,
+        breaker_store=breaker_store,
     )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(

@@ -301,6 +301,36 @@ class ExporterApp:
                     StackSampler() if cfg.trace_slow_poll_s > 0 else None
                 ),
             )
+        # Crash-safe state persistence (tpu_pod_exporter.persist): periodic
+        # checksummed checkpoint + WAL under --state-dir covering the
+        # history rings, breaker states, and the last published exposition.
+        # Restored state is applied HERE, before the first poll: breakers
+        # resume their quarantine, history answers across the restart, and
+        # the restored exposition serves immediately (warm start).
+        # --state-dir "" (the default) cleanly disables the whole layer.
+        self.persister = None
+        self._warm_snapshot = None
+        if cfg.state_dir:
+            from tpu_pod_exporter.persist import (
+                RestoredSnapshot,
+                StatePersister,
+            )
+
+            self.persister = StatePersister(
+                cfg.state_dir,
+                history=self.history,
+                supervisors=self.supervisors,
+                # Late-bound: whatever is being served when a checkpoint
+                # rotates (live snapshot, or the restored one during warm).
+                exposition_fn=lambda: self.store.current(),
+                snapshot_interval_s=cfg.state_snapshot_interval_s,
+                fsync_interval_s=cfg.state_fsync_interval_s,
+            )
+            restored = self.persister.load()
+            if restored.exposition:
+                self._warm_snapshot = RestoredSnapshot(
+                    restored.exposition, restored.exposition_ts
+                )
         # Scrape-latency distribution: handler threads observe, the
         # collector emits it into each snapshot (one poll behind, which is
         # fine for a cumulative histogram).
@@ -322,6 +352,8 @@ class ExporterApp:
             history=self.history,
             supervisors=self.supervisors,
             tracer=self.tracer,
+            persister=self.persister,
+            client_write_timeouts_fn=lambda: self.server.write_timeouts["total"],
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -341,7 +373,27 @@ class ExporterApp:
             debug_addr=cfg.debug_addr,
             live_fn=self._live_check,
             ready_detail_fn=self._ready_detail,
+            client_write_timeout_s=cfg.client_write_timeout_s,
+            warm_fn=self._warm_state,
         )
+
+    def _warm_state(self) -> dict | None:
+        """Non-None while the restored pre-restart snapshot is still what
+        /metrics serves (warm start, no live poll yet); the /readyz body
+        then reports state="warm" with the restored data's age."""
+        snap = self._warm_snapshot
+        if snap is None:
+            return None
+        if self.store.current() is not snap:
+            # Warm period over (first live poll swapped in): release the
+            # restored body and its lazy gzip/OpenMetrics caches — low-MB
+            # of dead memory otherwise held for the DaemonSet pod's life.
+            self._warm_snapshot = None
+            return None
+        return {
+            "restored_poll_age_s": round(time.time() - snap.poll_timestamp, 3),
+            "snapshot_stale_s": round(snap.stale_s, 3),
+        }
 
     def _live_check(self) -> str | None:
         """Immediate liveness failure when the poll loop is truly dead (its
@@ -416,6 +468,19 @@ class ExporterApp:
             }
         if self.history is not None:
             out["history"] = self.history.stats()
+        if self.persister is not None:
+            from tpu_pod_exporter.persist import state_dir_summary
+
+            out["persist"] = {
+                **self.persister.stats(),
+                # Nested, not splatted: restore-time counts (wal_records,
+                # errors) would otherwise shadow the live writer counters
+                # under the same names.
+                "restore": dict(self.persister.restored_info),
+                "dir": state_dir_summary(self.cfg.state_dir),
+                "warm": self._warm_state() is not None,
+            }
+        out["client_write_timeouts"] = self.server.write_timeouts["total"]
         if self.trace is not None:
             out["trace"] = self.trace.stats()
         if self.supervisors:
@@ -434,16 +499,60 @@ class ExporterApp:
         return self.server.port
 
     def start(self) -> None:
-        # First poll synchronously so /readyz flips as soon as we listen.
-        self.collector.poll_once()
-        self.loop.start()
-        self.server.start()
+        if self.persister is not None:
+            self.persister.start()
+        if self._warm_snapshot is not None:
+            # Warm start: serve the restored exposition IMMEDIATELY and let
+            # the first live poll run on the loop thread — blocking serving
+            # on a first poll against a possibly-still-wedged source is
+            # exactly the gap persistence exists to close. /readyz reports
+            # "warm" until the loop's first snapshot swap replaces it.
+            warm = self._warm_snapshot
+            self.store.swap(warm)
+            log.info(
+                "warm start: serving restored exposition (%.1fs stale, "
+                "%d series) while the first live poll runs",
+                warm.stale_s, warm.series_count,
+            )
+            self.loop.start()
+            self.server.start()
+
+            # Release the restored body (plus its lazy gzip/OpenMetrics
+            # caches — low-MB at 256 chips) as soon as the first live poll
+            # swaps it out. A watcher thread, not an HTTP-path hook: with
+            # no kubelet probing /readyz the memory would otherwise stay
+            # pinned for the process lifetime. Exits after one live poll.
+            def _release_warm() -> None:
+                poll_s = min(max(self.cfg.interval_s, 0.05), 1.0)
+                while self.store.current() is warm and not self.loop.dead:
+                    time.sleep(poll_s)
+                if self.store.current() is not warm:
+                    self._warm_snapshot = None
+                # else: the loop died while still warm — keep the warm
+                # marker truthful (readyz stays "warm"); /healthz's dead-
+                # loop 503 is already driving a pod restart.
+
+            threading.Thread(
+                target=_release_warm, name="tpu-exporter-warm-release",
+                daemon=True,
+            ).start()
+        else:
+            # Cold start: first poll synchronously so /readyz flips as soon
+            # as we listen.
+            self.collector.poll_once()
+            self.loop.start()
+            self.server.start()
         log.info("serving on :%d every %.3fs", self.port, self.cfg.interval_s)
 
     def stop(self) -> None:
         self.loop.stop()
         self.server.stop()
         self.collector.close()
+        if self.persister is not None:
+            # SIGTERM drain: final fsynced checkpoint (history + breakers +
+            # the exposition being served), so a rolling update warm-starts
+            # with zero staleness. After loop.stop() no poll can enqueue.
+            self.persister.close()
         if self.tracer is not None:
             self.tracer.close()
 

@@ -11,23 +11,29 @@ scanner) and injects faults on a **seeded, reproducible schedule**:
 - ``garbage`` — return a *well-formed but bogus* value (negative HBM, NaN
   duty cycle, label-hostile pod names) so value-robustness is exercised,
   not just control flow.
+- ``kill``    — SIGKILL the whole process mid-call: no drain, no flush, no
+  atexit — the crash the persistence layer (``persist.py``) must survive.
+  Exercised by ``make restart-demo``.
 
 Spec grammar (``--chaos-spec``, test-only flag)::
 
     spec  := rule ("," rule)*
     rule  := kind ":" source (":" token)*
-    kind  := hang | err | slow | garbage
+    kind  := hang | err | slow | garbage | kill
     source:= device | attribution | procscan
 
 Tokens after the source are order-free: a bare float in [0, 1] is the
 per-call probability (default 1.0), a duration with a unit ("500ms",
-"10s", "0.3s") is the hang/slow length, and ``xN`` caps the rule at N
-injections total. Examples::
+"10s", "0.3s") is the hang/slow length, ``xN`` caps the rule at N
+injections total, and ``@N`` arms the rule only from call index N on
+(0-based — the knob that places a kill *mid-run*, after state worth
+persisting exists). Examples::
 
     hang:device:0.01                 1% of device reads hang (default 3600s)
     err:attribution:0.05             5% of attribution reads raise
     slow:procscan:500ms              every process scan takes +500ms
     hang:device:1:10s:x3             the first three device reads hang 10s
+    kill:device:1:@20:x1             SIGKILL on the 21st device read
 
 Determinism: each source draws from its own ``random.Random`` seeded with
 ``f"{seed}:{source}"``, and the single poll thread calls sources in a fixed
@@ -48,7 +54,7 @@ from tpu_pod_exporter import trace as trace_mod
 
 log = logging.getLogger("tpu_pod_exporter.chaos")
 
-KINDS = ("hang", "err", "slow", "garbage")
+KINDS = ("hang", "err", "slow", "garbage", "kill")
 SOURCES = ("device", "attribution", "procscan")
 
 DEFAULT_HANG_S = 3600.0   # "forever" at poll-loop scale; the deadline fences it
@@ -56,6 +62,7 @@ DEFAULT_SLOW_S = 0.25
 
 _DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s)$")
 _COUNT_RE = re.compile(r"^x(\d+)$")
+_OFFSET_RE = re.compile(r"^@(\d+)$")
 
 
 class ChaosError(RuntimeError):
@@ -69,6 +76,7 @@ class ChaosRule:
     prob: float = 1.0
     duration_s: float | None = None  # hang/slow length; kind-default if None
     max_count: int | None = None     # total injection cap; None = unlimited
+    min_index: int = 0               # rule armed from this call index on (@N)
     fired: int = field(default=0, compare=False)
 
     @property
@@ -111,12 +119,17 @@ def parse_chaos_spec(spec: str) -> list[ChaosRule]:
             if m:
                 rule.max_count = int(m.group(1))
                 continue
+            m = _OFFSET_RE.match(tok)
+            if m:
+                rule.min_index = int(m.group(1))
+                continue
             try:
                 p = float(tok)
             except ValueError:
                 raise ValueError(
                     f"chaos rule {raw!r}: token {tok!r} is neither a "
-                    f"probability, a duration (500ms/10s), nor a count (x3)"
+                    f"probability, a duration (500ms/10s), a count (x3), "
+                    f"nor a call offset (@20)"
                 ) from None
             if not 0.0 <= p <= 1.0:
                 raise ValueError(
@@ -242,6 +255,7 @@ class ChaosWrapper:
             if (
                 triggered is None
                 and draw < rule.prob
+                and idx >= rule.min_index
                 and (rule.max_count is None or rule.fired < rule.max_count)
             ):
                 triggered = rule
@@ -260,6 +274,17 @@ class ChaosWrapper:
                 f"chaos: injected {triggered.kind}{detail} "
                 f"(call {idx}, rule {triggered.kind}:{triggered.source})"
             )
+            if triggered.kind == "kill":
+                # The crash persistence must survive: SIGKILL, delivered to
+                # ourselves, mid-call — no drain, no Python cleanup, no
+                # buffered-write flush. Anything not already fsynced is
+                # gone, which is the point (make restart-demo).
+                import os
+                import signal
+
+                log.critical("chaos: SIGKILL mid-%s-call (call %d)",
+                             self.source, idx)
+                os.kill(os.getpid(), signal.SIGKILL)
             if triggered.kind in ("hang", "slow"):
                 # Sleep OUTSIDE any inner lock, then proceed with the real
                 # call — a wedged-then-released source returns real data.

@@ -95,6 +95,8 @@ class Collector:
         history=None,  # HistoryStore fed after each snapshot swap
         supervisors=None,  # {"device"|"attribution"|"process_scan": SourceSupervisor}
         tracer=None,  # trace.Tracer; None = zero tracing work per poll
+        persister=None,  # persist.StatePersister; None = no persistence
+        client_write_timeouts_fn=None,  # () -> int, from the HTTP server
         clock=time.monotonic,
         wallclock=time.time,
     ) -> None:
@@ -139,6 +141,14 @@ class Collector:
         # publish/total timings).
         self._history = history
         self._history_append_s = 0.0
+        # Crash-safe persistence: fed once per poll AFTER the history
+        # append, on its own phase — like the history append it is
+        # excluded from the publish/total timings it is separately
+        # accounted against. The poll-side cost is one queue put; all
+        # I/O runs on the persister's writer thread.
+        self._persister = persister
+        self._persist_s = 0.0
+        self._client_write_timeouts_fn = client_write_timeouts_fn
         # Poll-phase faults repeat every interval (1 s) while a source is
         # down; rate-limit per fault key so logs show the fault, not 86k
         # lines/day. Per-instance: multiple collectors (tests, bench)
@@ -388,6 +398,29 @@ class Collector:
             # is excluded from publish/total: give it its own distribution
             # label so the per-phase heatmap shows where post-swap time goes.
             self._phase_hist.observe(self._history_append_s, ("history_append",))
+        # Persistence LAST, on its own supervised phase: the snapshot is
+        # swapped and the history append has run, so the WAL record covers
+        # exactly what a restart would need — and like the history append
+        # it never inflates the publish/total distributions (satellite
+        # audit: persistence I/O must not read as poll latency).
+        if self._persister is not None:
+            if tr is not None:
+                tr.begin("persist")
+            tq0 = self._clock()
+            queued = 0
+            persist_status = "ok"
+            try:
+                queued = self._persister.on_poll(snap)
+            except Exception as e:  # noqa: BLE001 — persistence must not fail a poll
+                persist_status = "err"
+                self._rlog.error(
+                    "persist", "persistence enqueue failed: %s", e,
+                    exc_info=True,
+                )
+            self._persist_s = self._clock() - tq0
+            if tr is not None:
+                tr.end(persist_status, queued=queued)
+            self._phase_hist.observe(self._persist_s, ("persist",))
         if tr is not None:
             tracer.finish(tr, status="ok" if stats.ok else "err",
                           errors=len(errors), skips=len(skips))
@@ -708,6 +741,12 @@ class Collector:
 
         # Self-metrics (SURVEY.md §5).
         b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)
+        # Warm-start markers: every LIVE poll publishes 0 — a restored
+        # exposition (persist.RestoredSnapshot) patches these two values to
+        # 1 / the measured staleness, which only works because the series
+        # are unconditionally present.
+        b.add(schema.TPU_EXPORTER_WARM_START, 0.0)
+        b.add(schema.TPU_EXPORTER_SNAPSHOT_STALE_SECONDS, 0.0)
         # This poll's read/join timings; publish/total are not known until
         # after the swap, so the previous poll's values stand in for them.
         for phase, dur in (
@@ -802,6 +841,14 @@ class Collector:
                 )
             except Exception:  # noqa: BLE001 — accounting must never fail a poll
                 pass
+        if self._client_write_timeouts_fn is not None:
+            try:
+                b.add(
+                    schema.TPU_EXPORTER_CLIENT_WRITE_TIMEOUTS_TOTAL,
+                    float(self._client_write_timeouts_fn()),
+                )
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
 
         # ICI counter state lives in self._chip_state (pruned above when it
         # outgrows its bound: vanished chips only, never live ones).
@@ -828,6 +875,31 @@ class Collector:
                 schema.TPU_EXPORTER_HISTORY_APPEND_SECONDS,
                 self._history_append_s,
             )
+
+        if self._persister is not None:
+            # Point-in-time persistence accounting (one poll behind, like
+            # every other self-stat read mid-publish).
+            try:
+                ps = self._persister.stats()
+                b.add(schema.TPU_EXPORTER_PERSIST_WAL_BYTES,
+                      float(ps["wal_bytes"]))
+                b.add(schema.TPU_EXPORTER_PERSIST_WAL_RECORDS_TOTAL,
+                      float(ps["wal_records"]))
+                b.add(schema.TPU_EXPORTER_PERSIST_SNAPSHOTS_TOTAL,
+                      float(ps["snapshots"]))
+                b.add(schema.TPU_EXPORTER_PERSIST_ERRORS_TOTAL,
+                      float(ps["errors"]))
+                b.add(schema.TPU_EXPORTER_PERSIST_DROPPED_TOTAL,
+                      float(ps["dropped"]))
+                b.add(schema.TPU_EXPORTER_PERSIST_FSYNC_SECONDS,
+                      ps["last_fsync_s"])
+                if ps["last_snapshot_wall"] > 0:
+                    b.add(
+                        schema.TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS,
+                        max(self._wallclock() - ps["last_snapshot_wall"], 0.0),
+                    )
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
 
         # +1 accounts for the series-count series itself.
         b.add(schema.TPU_EXPORTER_SERIES, float(b.series_count + 1))

@@ -103,6 +103,33 @@ class ExporterConfig:
     # never thrashes; memory is allocated per series actually present
     # (~32 MB at 256 chips, ~0.6 MB on a v4-8 host).
     history_max_series: int = 8192
+    # Crash-safe state persistence (tpu_pod_exporter.persist): directory
+    # for the checksummed checkpoint + write-ahead log covering history
+    # rings, breaker state, and the last published exposition. On boot the
+    # exporter replays it (torn-write tolerant — a corrupt record truncates,
+    # never refuses to start) and serves the restored exposition
+    # immediately (warm start). Empty (the default) cleanly disables the
+    # whole layer. In the DaemonSet, point it at a hostPath so state
+    # survives pod replacement, e.g. /var/lib/tpu-pod-exporter.
+    state_dir: str = ""
+    # Checkpoint cadence: full state (history + breakers + exposition) is
+    # rewritten atomically (write-temp, fsync, rename) this often; the WAL
+    # resets after each checkpoint, bounding both restore time and WAL
+    # growth.
+    state_snapshot_interval_s: float = 60.0
+    # WAL fsync cadence: a crash loses at most this much of the history
+    # tail (plus the in-flight poll). 0 = fsync every record — the
+    # strongest guarantee, affordable on local SSD (make
+    # persist-fsync-check measures it).
+    state_fsync_interval_s: float = 5.0
+    # Slow-client write defense: per-connection socket SEND timeout. A
+    # scraper that stalls mid-body (stuck TCP peer, frozen pipe) gets its
+    # connection dropped after this many seconds instead of pinning a
+    # handler thread forever; counted in
+    # tpu_exporter_client_write_timeouts_total. 0 disables. Send-only
+    # (SO_SNDTIMEO): idle keep-alive connections between scrapes are
+    # unaffected.
+    client_write_timeout_s: float = 10.0
     # /debug/* exposure: by default debug endpoints only answer loopback
     # clients (run curl on the node). "0.0.0.0" serves them to any client
     # (the pre-round-5 behaviour); the metrics/health/api endpoints are

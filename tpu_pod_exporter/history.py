@@ -310,6 +310,61 @@ class HistoryStore:
             self._evict_gen += 1
             self._layouts.clear()
 
+    # ----------------------------------------------- persistence (persist.py)
+
+    def export_series(self) -> list[tuple[str, dict, list[tuple[float, float]]]]:
+        """Every series' full ring as ``(metric, labels, [(t_wall, value),
+        …])`` oldest-first — the checkpoint payload for crash-safe
+        persistence. Raw ``array('d')`` slices are copied under the lock
+        (same discipline as :meth:`_rows_for`); the per-sample tuples are
+        built outside it."""
+        with self._lock:
+            rows = [
+                (s.name, dict(s.labels), s.cap, s.n, s.head, s.tw[:], s.vals[:])
+                for s in self._series.values()
+            ]
+        out = []
+        for name, labels, cap, n, head, tw, vals in rows:
+            start = (head - n) % cap
+            samples = [
+                (tw[i], vals[i])
+                for i in ((start + k) % cap for k in range(n))
+            ]
+            out.append((name, labels, samples))
+        return out
+
+    def restore_series(
+        self, metric: str, labels: Mapping[str, str],
+        samples: list[tuple[float, float]], wall_to_mono,
+    ) -> int:
+        """Bulk-append persisted samples (oldest first) at boot. Monotonic
+        timestamps are reconstructed from wall time via ``wall_to_mono``
+        (the restart reset the monotonic clock); appending past capacity
+        simply wraps the ring, keeping the newest samples. Returns the
+        number of samples appended.
+
+        Key discipline: the restored series MUST land under the exact key
+        the collector's ``append_snapshot`` will use on the first live
+        poll — ``(metric, label-VALUE tuple in spec order)`` — or restored
+        and live samples fork into two series with identical labels and
+        the continuity the restore exists for is silently lost. Metrics
+        outside the schema fall back to the sorted-items key that the
+        generic :meth:`append` path uses, for the same reason."""
+        spec = _SPEC_BY_NAME.get(metric)
+        if spec is not None:
+            key = (metric, tuple(str(labels.get(ln, ""))
+                                 for ln in spec.label_names))
+        else:
+            key = (metric, tuple(sorted(labels.items())))
+        lbl = dict(labels)
+        with self._lock:
+            for t_wall, value in samples:
+                self._append_locked(
+                    key, metric, lbl, float(value),
+                    wall_to_mono(t_wall), t_wall,
+                )
+        return len(samples)
+
     # ----------------------------------------------------------------- query
 
     @staticmethod

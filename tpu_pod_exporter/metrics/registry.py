@@ -357,6 +357,14 @@ class Snapshot:
         fam = self._families.get(name)
         return dict(fam.samples) if fam is not None else {}
 
+    def samples_view(self, name: str) -> dict[tuple[str, ...], float] | None:
+        """Zero-copy handle on one family's samples dict (None when the
+        family is absent). Snapshots are immutable after ``build``, so the
+        persistence writer thread reads these without copies or locks —
+        callers MUST NOT mutate the returned dict."""
+        fam = self._families.get(name)
+        return fam.samples if fam is not None else None
+
     def encode(self) -> bytes:
         """Prometheus text exposition format (rendered once, then cached).
 

@@ -356,6 +356,29 @@ TPU_EXPORTER_TRACE_SPANS = MetricSpec(
     type=GAUGE,
 )
 
+# --- Restart survivability (tpu_pod_exporter.persist) ------------------------
+# warm_start / snapshot_stale live in ALL_SPECS (published 0 on every live
+# poll) so the restored exposition can flip them by VALUE EDIT, never by
+# header injection — a warm body stays a valid single-header exposition.
+
+TPU_EXPORTER_WARM_START = MetricSpec(
+    name="tpu_exporter_warm_start",
+    help="1 while serving the restored pre-restart exposition snapshot (warm start: the process restarted and no live poll has completed yet); 0 on every live poll. Scrapes during warm start carry last-known data — check tpu_exporter_snapshot_stale_seconds for its age.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_SNAPSHOT_STALE_SECONDS = MetricSpec(
+    name="tpu_exporter_snapshot_stale_seconds",
+    help="Age of the restored exposition at the moment serving resumed after a restart (0 on live polls). Combine with tpu_exporter_last_poll_timestamp_seconds for ongoing staleness while warm_start=1.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_CLIENT_WRITE_TIMEOUTS_TOTAL = MetricSpec(
+    name="tpu_exporter_client_write_timeouts_total",
+    help="Connections dropped because a client stalled reading a response past --client-write-timeout-s (per-connection socket send timeout): a wedged scraper must not pin a handler thread forever.",
+    type=COUNTER,
+)
+
 TPU_EXPORTER_INFO = MetricSpec(
     name="tpu_exporter_info",
     help="Static exporter build/runtime info; value is always 1.",
@@ -406,6 +429,64 @@ HISTORY_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_HISTORY_MEMORY_BYTES,
     TPU_EXPORTER_HISTORY_EVICTED_SERIES_TOTAL,
     TPU_EXPORTER_HISTORY_APPEND_SECONDS,
+)
+
+# --- Persistence self-metrics (tpu_pod_exporter.persist) ----------------------
+# Emitted only when persistence is enabled (--state-dir set) — the same
+# conditional-surface rule as HISTORY_SPECS. The WAL/snapshot health must be
+# auditable from the exposition: a silently-failing state dir would only be
+# discovered at the NEXT restart, which is exactly too late.
+
+TPU_EXPORTER_PERSIST_WAL_BYTES = MetricSpec(
+    name="tpu_exporter_persist_wal_bytes",
+    help="Current size of the write-ahead log under --state-dir (resets to near zero at each checkpoint rotation).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_PERSIST_WAL_RECORDS_TOTAL = MetricSpec(
+    name="tpu_exporter_persist_wal_records_total",
+    help="WAL records written since exporter start (samples, layout, and breaker records).",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_PERSIST_SNAPSHOTS_TOTAL = MetricSpec(
+    name="tpu_exporter_persist_snapshots_total",
+    help="State checkpoints written since exporter start (write-temp, fsync, rename; cadence --state-snapshot-interval-s).",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_PERSIST_ERRORS_TOTAL = MetricSpec(
+    name="tpu_exporter_persist_errors_total",
+    help="Persistence I/O failures since start (WAL writes, fsyncs, checkpoint rotations). Rising = the state dir's filesystem is failing; the exporter keeps polling but the next restart will cold-start or restore stale state.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_PERSIST_DROPPED_TOTAL = MetricSpec(
+    name="tpu_exporter_persist_dropped_total",
+    help="Poll records dropped because the persistence writer's queue was full (stalled disk): polling is never blocked by persistence, so sustained drops mean history restored after a crash will have holes.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_PERSIST_FSYNC_SECONDS = MetricSpec(
+    name="tpu_exporter_persist_fsync_seconds",
+    help="Duration of the most recent WAL fsync (cadence --state-fsync-interval-s; 0 syncs every record). The persistence hot path's latency budget check (make persist-fsync-check) polices the same number in CI.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS = MetricSpec(
+    name="tpu_exporter_persist_snapshot_age_seconds",
+    help="Seconds since the last on-disk state checkpoint was written (the worst-case exposition staleness a crash right now would restore). Absent until the first rotation of this process.",
+    type=GAUGE,
+)
+
+PERSIST_SPECS: tuple[MetricSpec, ...] = (
+    TPU_EXPORTER_PERSIST_WAL_BYTES,
+    TPU_EXPORTER_PERSIST_WAL_RECORDS_TOTAL,
+    TPU_EXPORTER_PERSIST_SNAPSHOTS_TOTAL,
+    TPU_EXPORTER_PERSIST_ERRORS_TOTAL,
+    TPU_EXPORTER_PERSIST_DROPPED_TOTAL,
+    TPU_EXPORTER_PERSIST_FSYNC_SECONDS,
+    TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS,
 )
 
 # --- Legacy migration aliases (off by default; --legacy-metrics) ------------
@@ -464,6 +545,9 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_SLOW_POLLS_TOTAL,
     TPU_EXPORTER_TRACES,
     TPU_EXPORTER_TRACE_SPANS,
+    TPU_EXPORTER_WARM_START,
+    TPU_EXPORTER_SNAPSHOT_STALE_SECONDS,
+    TPU_EXPORTER_CLIENT_WRITE_TIMEOUTS_TOTAL,
     TPU_EXPORTER_INFO,
 )
 

@@ -9,8 +9,10 @@ parts").
 
 Additional endpoints the reference lacks:
 - ``/healthz`` — liveness (process up, returns 200 always).
-- ``/readyz`` — readiness (200 once at least one poll has completed, 503
-  before; lets a DaemonSet rolling update wait for real data).
+- ``/readyz`` — readiness JSON (200 once data is being served, 503
+  before) with a ``state`` field: ``starting`` / ``warm`` (serving a
+  restored pre-restart snapshot, first live poll pending — see
+  ``tpu_pod_exporter.persist``) / ``ready`` / ``degraded``.
 - ``/api/v1/series`` / ``/api/v1/query_range`` / ``/api/v1/window_stats`` —
   JSON queries against the node-local history flight recorder
   (``tpu_pod_exporter.history``); served on the metrics port because the
@@ -31,6 +33,8 @@ from __future__ import annotations
 import json
 import logging
 import math
+import socket
+import struct
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -248,9 +252,51 @@ class _Handler(BaseHTTPRequestHandler):
     # tpu_exporter_scrape_duration_seconds histogram; must stay cheap, it
     # runs on the scrape path.
     scrape_observer = None
+    # Slow-client write defense: per-connection socket SEND timeout
+    # (SO_SNDTIMEO — receive-side keep-alive idling is unaffected). A
+    # scraper that stops reading mid-body would otherwise pin this handler
+    # thread inside sendall() forever; with the option set, the blocked
+    # send raises after this many seconds, the connection is dropped, and
+    # the drop is counted (tpu_exporter_client_write_timeouts_total).
+    client_write_timeout_s: float = 10.0
+    write_timeouts = None  # {"total": int}, shared per server
+    write_timeouts_lock: threading.Lock | None = None
+    # Optional () -> dict|None: non-None means the server is WARM-serving a
+    # restored pre-restart snapshot (no live poll yet); merged into the
+    # /readyz body as state="warm" detail. See tpu_pod_exporter.persist.
+    warm_fn = None
     protocol_version = "HTTP/1.1"
 
+    def setup(self) -> None:
+        super().setup()
+        t = self.client_write_timeout_s
+        if t > 0:
+            try:
+                # struct timeval: two C longs on every platform this runs
+                # on (linux). Failure just means no write fence — never a
+                # refused connection.
+                self.connection.setsockopt(
+                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
+                    struct.pack("ll", int(t), int((t - int(t)) * 1e6)),
+                )
+            except (OSError, ValueError, struct.error):
+                pass
+
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
+        try:
+            self._route_get()
+        except (TimeoutError, BlockingIOError) as e:
+            # SO_SNDTIMEO fired mid-response: the client stalled reading.
+            # Count it, kill the (half-written) connection, swallow — the
+            # stdlib would otherwise stack-trace a client-side fault.
+            if self.write_timeouts is not None:
+                with self.write_timeouts_lock:
+                    self.write_timeouts["total"] += 1
+            self.close_connection = True
+            log.debug("client write timeout from %s: %s",
+                      self.client_address[0], e)
+
+    def _route_get(self) -> None:
         path, _, query = self.path.partition("?")
         if path == "/metrics":
             self._serve_metrics()
@@ -321,15 +367,34 @@ class _Handler(BaseHTTPRequestHandler):
             snap = self.store.current()
             ready = snap.timestamp > 0
             body: dict = {"ready": ready}
+            warm = None
+            if ready and self.warm_fn is not None:
+                try:
+                    warm = type(self).warm_fn()
+                except Exception:  # noqa: BLE001 — warm detail must not break probes
+                    warm = None
             if not ready:
+                body["state"] = "starting"
                 body["reason"] = "no poll completed yet"
+            elif warm is not None:
+                # Serving the restored pre-restart snapshot; no live poll
+                # yet. Still 200 — data IS being served (that is the whole
+                # point of warm start) — but distinctly labeled so rollouts
+                # and operators can tell restored from live.
+                body["state"] = "warm"
+                body.update(warm)
+            else:
+                body["state"] = "ready"
             if self.ready_detail_fn is not None:
                 try:
-                    body.update(type(self).ready_detail_fn() or {})
+                    detail = type(self).ready_detail_fn() or {}
+                    body.update(detail)
+                    if detail.get("degraded_sources") and body["state"] == "ready":
+                        body["state"] = "degraded"
                 except Exception:  # noqa: BLE001 — detail must not break probes
                     pass
             # JSON either way (kubelet only reads the status code; humans
-            # and the RUNBOOK read the degraded-source detail).
+            # and the RUNBOOK read the state + degraded-source detail).
             self._serve_json(200 if ready else 503, body)
         elif path == "/":
             self._serve_text(
@@ -615,10 +680,13 @@ class MetricsServer:
         debug_addr: str = "127.0.0.1",
         live_fn=None,
         ready_detail_fn=None,
+        client_write_timeout_s: float = 10.0,
+        warm_fn=None,
     ) -> None:
         # Both causes pre-seeded so the self-metric publishes a 0 series
         # per cause from poll 1 (stable surface).
         self.scrape_rejects = {"concurrency": 0, "rate": 0}
+        self.write_timeouts = {"total": 0}
         handler = type(
             "BoundHandler",
             (_Handler,),
@@ -636,6 +704,10 @@ class MetricsServer:
                 "ready_detail_fn": (
                     staticmethod(ready_detail_fn) if ready_detail_fn else None
                 ),
+                "warm_fn": staticmethod(warm_fn) if warm_fn else None,
+                "client_write_timeout_s": client_write_timeout_s,
+                "write_timeouts": self.write_timeouts,
+                "write_timeouts_lock": threading.Lock(),
                 "scrape_sem": (
                     threading.BoundedSemaphore(max_concurrent_scrapes)
                     if max_concurrent_scrapes > 0

@@ -113,6 +113,28 @@ def _fmt_delta_bytes(d: float) -> str:
     return ("+" if d >= 0 else "-") + fmt_bytes(abs(d))
 
 
+def persist_line(state_dir: str) -> str | None:
+    """``state-dir: …`` footer: on-disk size, checkpoint age, and whether a
+    restart right now would warm-start (checkpoint present) or cold-start.
+    The checkpoint age IS the worst-case staleness a crash-restore would
+    serve — the operator-facing read of tpu_exporter_snapshot_stale_seconds
+    before it happens."""
+    from tpu_pod_exporter.persist import state_dir_summary
+
+    s = state_dir_summary(state_dir)
+    if not s["exists"]:
+        return f"state-dir: {state_dir} (missing — restart would cold-start)"
+    if s["snapshot_bytes"]:
+        age = s["snapshot_age_s"]
+        warm = (f"warm restart ready, checkpoint {age:g}s stale"
+                if age is not None else "warm restart ready")
+    else:
+        warm = "no checkpoint yet — restart would cold-start"
+    return (f"state-dir: {state_dir} {fmt_bytes(s['total_bytes'])} "
+            f"(checkpoint {fmt_bytes(s['snapshot_bytes'])}, "
+            f"wal {fmt_bytes(s['wal_bytes'])}) · {warm}")
+
+
 # Series name the watch-mode phase breakdown stores its timings under — the
 # same family the exporter's per-phase histogram publishes, so the footer
 # reads as a local preview of the daemon's phase heatmap.
@@ -284,8 +306,14 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
     if as_json:
         import json
 
+        persist = None
+        if cfg.state_dir:
+            from tpu_pod_exporter.persist import state_dir_summary
+
+            persist = state_dir_summary(cfg.state_dir)
         print(json.dumps({
             "accelerator": topo.accelerator,
+            "persist": persist,
             "slice_name": topo.slice_name,
             "host": topo.host,
             "worker_id": topo.worker_id,
@@ -324,6 +352,11 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
         if scanner is not None:
             phases.append("process_scan")
         line = phase_breakdown_line(history, phases, trend_window_s)
+        if line:
+            print()
+            print(line)
+    if cfg.state_dir:
+        line = persist_line(cfg.state_dir)
         if line:
             print()
             print(line)

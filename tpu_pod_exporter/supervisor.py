@@ -145,6 +145,64 @@ class CircuitBreaker:
             return 0.0
         return max(self._next_probe_at - self._clock(), 0.0)
 
+    # ------------------------------------------------- persistence (persist.py)
+
+    def export_state(self, wallclock=time.time) -> dict:
+        """Serializable breaker state for crash-safe persistence. The open
+        window is exported as an absolute WALL deadline (``open_until_wall``)
+        because the monotonic clock does not survive a restart."""
+        return {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "reopens": self.reopens,
+            "backoff_s": self._backoff_s,
+            "open_until_wall": (
+                wallclock() + self.seconds_until_probe
+                if self.state == OPEN else 0.0
+            ),
+            "transitions": dict(self.transitions),
+        }
+
+    def restore_state(self, doc: dict, wallclock=time.time) -> None:
+        """Rehydrate from :meth:`export_state` output (defensively: the
+        payload crossed a process death and a disk). A restored OPEN
+        breaker keeps its remaining backoff window — the restarted process
+        must not re-learn a still-wedged source from closed — and a
+        breaker persisted mid-probe (HALF_OPEN) restores as OPEN with the
+        probe due immediately: the in-flight probe died with the process,
+        so the honest state is 'quarantined, probe now'."""
+        state = doc.get("state")
+        if state not in (CLOSED, OPEN, HALF_OPEN):
+            return
+        self.consecutive_failures = max(int(doc.get("consecutive_failures", 0)), 0)
+        self.reopens = max(int(doc.get("reopens", 0)), 0)
+        self._backoff_s = min(
+            max(float(doc.get("backoff_s", 0.0)), 0.0), self.backoff_max_s
+        )
+        transitions = doc.get("transitions")
+        if isinstance(transitions, dict):
+            for key in self.transitions:
+                try:
+                    self.transitions[key] = max(int(transitions.get(key, 0)), 0)
+                except (TypeError, ValueError):
+                    pass
+        if state == CLOSED:
+            self.state = CLOSED
+            return
+        self.state = OPEN
+        remaining = 0.0
+        if state == OPEN:
+            try:
+                remaining = float(doc.get("open_until_wall", 0.0)) - wallclock()
+            except (TypeError, ValueError):
+                remaining = 0.0
+        # Clamp into [0, ceiling]: a wall clock that stepped during the
+        # restart must not quarantine a source for hours, nor probe in
+        # the past.
+        self._next_probe_at = self._clock() + min(
+            max(remaining, 0.0), self.backoff_max_s
+        )
+
     def _open(self) -> None:
         if self._backoff_s <= 0:
             self._backoff_s = self.backoff_base_s
