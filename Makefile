@@ -1,8 +1,39 @@
 # Developer entry points.
-.PHONY: test native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead clean
 
 test:
 	python -m pytest tests/ -q
+
+# Static analysis gate (README "Static analysis"). Two layers:
+#   exporter-lint — the codebase's own invariant rules (lock discipline,
+#     schema-registered metric names, monotonic-clock, thread conventions,
+#     /debug gating, flag coverage), stdlib-only, always runs; fails on any
+#     finding not in .exporter-lint-baseline.json.
+#   ruff — generic real-bug pass (F + E9 only), runs when installed
+#     (CI always installs it; minimal dev boxes skip with a notice).
+lint:
+	python -m tpu_pod_exporter.analysis
+	@if python -c "import ruff" 2>/dev/null; then \
+		python -m ruff check tpu_pod_exporter tests; \
+	else \
+		echo "ruff not installed; skipped (CI runs it — pip install ruff)"; \
+	fi
+
+# Strict-ish typing on the core modules ([tool.mypy] in pyproject.toml).
+# Gated on availability for the same reason as ruff above.
+typecheck:
+	@if python -c "import mypy" 2>/dev/null; then \
+		python -m mypy tpu_pod_exporter; \
+	else \
+		echo "mypy not installed; skipped (CI runs it — pip install mypy)"; \
+	fi
+
+# Seed a deliberate lock-scoped json.dumps + an unregistered metric name
+# into a temp copy of collector.py and show exporter-lint catching both —
+# the lint analog of chaos-demo/trace-demo/restart-demo (exits non-zero
+# if a seeded violation slips through).
+lint-demo:
+	python -m tpu_pod_exporter.analysis --demo
 
 # Replay the round-5 real-hardware trace through the history flight
 # recorder and print what /api/v1/window_stats would answer — the offline

@@ -1,0 +1,47 @@
+"""Static analysis — the exporter's invariants as machine-checked rules.
+
+The codebase's correctness rests on conventions that no general-purpose
+linter knows about: the copy-then-serialize lock discipline (every review
+round of PRs 1-4 caught serialization under a lock by eye), the frozen
+``metrics/schema.py`` surface (a metric name not in ``ALL_SPECS`` silently
+forks the exposition contract), the monotonic-clock rule on the poll path,
+the ``tpu-sup-*``/named-daemon thread conventions, and the loopback gate on
+``/debug/*``. ``exporter-lint`` encodes each as a named AST rule with
+file:line diagnostics, a severity, an inline
+``# lint: disable=RULE(reason)`` escape hatch, and a committed baseline for
+grandfathered findings — so ``make lint`` is green from day one and every
+NEW violation fails CI naming the rule, file, and line.
+
+Usage::
+
+    python -m tpu_pod_exporter.analysis            # lint the package
+    python -m tpu_pod_exporter.analysis --demo     # seed + catch a violation
+    exporter-lint --format json                    # CI artifact shape
+
+See README.md "Static analysis" for the rule reference and
+``deploy/RUNBOOK.md`` for the operator workflow (updating the baseline,
+recording an intentional exception).
+"""
+
+from __future__ import annotations
+
+from tpu_pod_exporter.analysis.diagnostics import (
+    Diagnostic,
+    parse_disables,
+)
+from tpu_pod_exporter.analysis.engine import (
+    LintContext,
+    lint_package,
+    lint_source,
+)
+from tpu_pod_exporter.analysis.rules import ALL_RULES, Rule
+
+__all__ = [
+    "ALL_RULES",
+    "Diagnostic",
+    "LintContext",
+    "Rule",
+    "lint_package",
+    "lint_source",
+    "parse_disables",
+]

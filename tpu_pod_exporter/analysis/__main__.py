@@ -1,0 +1,163 @@
+"""``exporter-lint`` CLI — the invariant gate behind ``make lint``.
+
+Exit status: 0 when the tree is clean against the committed baseline,
+1 when any new finding exists (each printed as ``file:line: severity:
+rule: message``), 2 on operational errors (missing schema, bad root).
+
+``--demo`` seeds a deliberate lock-scoped ``json.dumps`` and an
+unregistered metric name into a temp copy of ``collector.py`` and shows
+the linter catching both — the lint analog of ``make chaos-demo``
+(exits 0 only if BOTH seeded violations are caught).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from tpu_pod_exporter.analysis.diagnostics import ERROR
+from tpu_pod_exporter.analysis.engine import (
+    apply_baseline,
+    baseline_document,
+    lint_package,
+    load_baseline,
+)
+from tpu_pod_exporter.analysis.rules import ALL_RULES
+
+BASELINE_NAME = ".exporter-lint-baseline.json"
+
+
+def _default_root() -> str:
+    # analysis/__main__.py -> analysis -> tpu_pod_exporter -> repo root.
+    here = os.path.dirname(os.path.abspath(__file__))
+    return os.path.dirname(os.path.dirname(here))
+
+
+def _run_demo(root: str) -> int:
+    """Copy collector.py aside, seed two violations, show the diagnostics."""
+    import shutil
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="exporter-lint-demo-") as tmp:
+        pkg = os.path.join(tmp, "tpu_pod_exporter")
+        shutil.copytree(
+            os.path.join(root, "tpu_pod_exporter"), pkg,
+            ignore=shutil.ignore_patterns("__pycache__"),
+        )
+        target = os.path.join(pkg, "collector.py")
+        with open(target, "a") as f:
+            f.write(
+                "\n\n"
+                "def _lint_demo_seeded(snapshot, counters):\n"
+                "    # Seeded by `exporter-lint --demo`: BOTH lines below\n"
+                "    # violate an invariant rule on purpose.\n"
+                "    import json\n"
+                "    import threading\n"
+                "    demo_lock = threading.Lock()\n"
+                "    with demo_lock:\n"
+                "        body = json.dumps({'seeded': True})\n"
+                "    counters.inc('tpu_exporter_demo_bogus_total', ())\n"
+                "    return body\n"
+            )
+        print("seeded into a temp copy of tpu_pod_exporter/collector.py:")
+        print("  - json.dumps(...) inside `with demo_lock:`   (rule lock-io)")
+        print("  - metric name 'tpu_exporter_demo_bogus_total' not in "
+              "schema.ALL_SPECS   (rule metric-name)")
+        print()
+        findings = [
+            d for d in lint_package(tmp)
+            if d.path == "tpu_pod_exporter/collector.py"
+        ]
+        caught = set()
+        for d in findings:
+            print(d.format())
+            caught.add(d.rule)
+        ok = {"lock-io", "metric-name"} <= caught
+        print()
+        print("demo:", "PASS — both seeded violations caught"
+              if ok else "FAIL — a seeded violation was NOT caught")
+        return 0 if ok else 1
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="exporter-lint",
+        description="AST-enforced invariant lint for tpu-pod-exporter.",
+    )
+    p.add_argument("--root", default=_default_root(),
+                   help="repo root containing tpu_pod_exporter/ (default: "
+                        "auto-detected from this file's location)")
+    p.add_argument("--baseline", default=None,
+                   help=f"baseline JSON path (default: <root>/{BASELINE_NAME})")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="report every finding, ignoring the baseline")
+    p.add_argument("--update-baseline", action="store_true",
+                   help="write all current findings to the baseline and exit 0")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule reference and exit")
+    p.add_argument("--demo", action="store_true",
+                   help="seed a violation into a temp copy and show the "
+                        "diagnostic (make lint-demo)")
+    ns = p.parse_args(argv)
+
+    if ns.list_rules:
+        for rule in ALL_RULES:
+            print(f"{rule.name:18s} {rule.severity:8s} {rule.summary}")
+        return 0
+
+    root = os.path.abspath(ns.root)
+    if not os.path.isdir(os.path.join(root, "tpu_pod_exporter")):
+        print(f"exporter-lint: no tpu_pod_exporter/ under {root}",
+              file=sys.stderr)
+        return 2
+
+    if ns.demo:
+        return _run_demo(root)
+
+    findings = lint_package(root)
+    baseline_path = ns.baseline or os.path.join(root, BASELINE_NAME)
+
+    if ns.update_baseline:
+        doc = baseline_document(findings, root)
+        with open(baseline_path, "w") as f:
+            json.dump(doc, f, indent=1)
+            f.write("\n")
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    suppressed = 0
+    if not ns.no_baseline:
+        findings, suppressed = apply_baseline(
+            findings, load_baseline(baseline_path), root
+        )
+
+    if ns.format == "json":
+        print(json.dumps({
+            "findings": [
+                {
+                    "rule": d.rule, "severity": d.severity, "path": d.path,
+                    "line": d.line, "message": d.message,
+                }
+                for d in findings
+            ],
+            "baseline_suppressed": suppressed,
+        }, indent=1))
+    else:
+        for d in findings:
+            print(d.format())
+        errors = sum(1 for d in findings if d.severity == ERROR)
+        warnings = len(findings) - errors
+        tail = f" ({suppressed} grandfathered in baseline)" if suppressed else ""
+        if findings:
+            print(f"exporter-lint: {errors} error(s), {warnings} warning(s)"
+                  f"{tail}")
+        else:
+            print(f"exporter-lint: clean{tail}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -28,6 +28,7 @@ import logging
 import threading
 import time
 from dataclasses import dataclass
+from typing import TYPE_CHECKING, Any, Callable, Iterable, Sequence
 
 from tpu_pod_exporter.attribution import (
     AttributionError,
@@ -50,6 +51,13 @@ from tpu_pod_exporter.supervisor import SourceSkipped, SourceTimeout
 from tpu_pod_exporter.topology import HostTopology
 from tpu_pod_exporter.utils import RateLimitedLogger
 from tpu_pod_exporter.version import __version__
+
+if TYPE_CHECKING:  # import-cycle-free typing only
+    from tpu_pod_exporter.history import HistoryStore
+    from tpu_pod_exporter.metrics.registry import Snapshot
+    from tpu_pod_exporter.persist import StatePersister
+    from tpu_pod_exporter.supervisor import SourceSupervisor
+    from tpu_pod_exporter.trace import PollTrace, Tracer
 
 log = logging.getLogger("tpu_pod_exporter.collector")
 
@@ -88,17 +96,25 @@ class Collector:
         resource_name: str = TPU_RESOURCE_NAME,
         attribution_max_stale_s: float = 30.0,
         legacy_metrics: bool = False,
-        process_scanner=None,
-        scrape_rejects_fn=None,  # () -> {cause: int}, from the HTTP guard
-        loop_overruns_fn=None,   # () -> int, from the CollectorLoop
-        scrape_duration_hist=None,  # HistogramStore fed by the HTTP server
-        history=None,  # HistoryStore fed after each snapshot swap
-        supervisors=None,  # {"device"|"attribution"|"process_scan": SourceSupervisor}
-        tracer=None,  # trace.Tracer; None = zero tracing work per poll
-        persister=None,  # persist.StatePersister; None = no persistence
-        client_write_timeouts_fn=None,  # () -> int, from the HTTP server
-        clock=time.monotonic,
-        wallclock=time.time,
+        process_scanner: Any = None,
+        # () -> {cause: int}, from the HTTP guard
+        scrape_rejects_fn: Callable[[], dict[str, int]] | None = None,
+        # () -> int, from the CollectorLoop
+        loop_overruns_fn: Callable[[], int] | None = None,
+        # HistogramStore fed by the HTTP server
+        scrape_duration_hist: HistogramStore | None = None,
+        # HistoryStore fed after each snapshot swap
+        history: "HistoryStore | None" = None,
+        # {"device"|"attribution"|"process_scan": SourceSupervisor}
+        supervisors: "dict[str, SourceSupervisor] | None" = None,
+        # trace.Tracer; None = zero tracing work per poll
+        tracer: "Tracer | None" = None,
+        # persist.StatePersister; None = no persistence
+        persister: "StatePersister | None" = None,
+        # () -> int, from the HTTP server
+        client_write_timeouts_fn: Callable[[], int] | None = None,
+        clock: Callable[[], float] = time.monotonic,
+        wallclock: Callable[[], float] = time.time,
     ) -> None:
         self._backend = backend
         self._attribution = attribution
@@ -442,7 +458,7 @@ class Collector:
         return stats
 
     def _read_attribution(self, errors: list[str], skips: list[str],
-                          tr=None) -> AttributionSnapshot | None:
+                          tr: "PollTrace | None" = None) -> AttributionSnapshot | None:
         now = self._clock()
         sup = self._supervisors.get("attribution")
         if tr is not None:
@@ -491,7 +507,7 @@ class Collector:
 
     # ------------------------------------------------- phase fault tracking
 
-    def _count_phase_failure(self, key: str, sup) -> None:
+    def _count_phase_failure(self, key: str, sup: "SourceSupervisor | None") -> None:
         """Track consecutive failures for recovery log lines — only on the
         unsupervised path (a SourceSupervisor tracks and logs its own)."""
         if sup is None:
@@ -511,8 +527,11 @@ class Collector:
 
     # --------------------------------------------------------------- publish
 
-    def _publish(self, host_sample, device_owner, stats: PollStats, now_mono: float,
-                 allocatable=None, allocated=None, holders=None) -> None:
+    def _publish(self, host_sample: HostSample | None,
+                 device_owner: dict[str, Any], stats: PollStats,
+                 now_mono: float, allocatable: Iterable[str] | None = None,
+                 allocated: int | None = None,
+                 holders: Sequence[Any] | None = None) -> "Snapshot":
         b = SnapshotBuilder(prefix_cache=self._prefix_cache)
 
         # Declare the full schema up front so families are present (and typed)
@@ -909,7 +928,9 @@ class Collector:
 
     # ------------------------------------------------------------- ICI fold
 
-    def _fold_ici_fast(self, ici_total_s, ici_bw_s, dt, seq) -> None:
+    def _fold_ici_fast(self, ici_total_s: dict[tuple[str, ...], float],
+                       ici_bw_s: dict[tuple[str, ...], float],
+                       dt: float, seq: int) -> None:
         """Steady-state fold: raw totals were extracted into flat['raw_buf']
         by the chip loop (layout verified); delta/clip/accumulate/rate happen
         as four numpy ops over all links at once, and the series dicts fill
@@ -950,7 +971,10 @@ class Collector:
             rec[3] = seq
         self._ici_flat = None
 
-    def _fold_ici_slow(self, chip_cached, ici_total_s, ici_bw_s, dt, seq) -> None:
+    def _fold_ici_slow(self, chip_cached: list[tuple[Any, tuple]],
+                       ici_total_s: dict[tuple[str, ...], float],
+                       ici_bw_s: dict[tuple[str, ...], float],
+                       dt: float | None, seq: int) -> None:
         """Per-link fold (first poll, churn, layout change): the reference
         semantics — monotonic fold with reset tolerance, rate only for links
         also seen at seq-1 — and the builder of the flat block the fast path
@@ -1019,7 +1043,10 @@ class Collector:
             "seq": seq,
         }
 
-    def _fold_dcn(self, chip_cached, dcn_total_s, dcn_bw_s, dt, seq) -> None:
+    def _fold_dcn(self, chip_cached: list[tuple[Any, tuple]],
+                  dcn_total_s: dict[tuple[str, ...], float],
+                  dcn_bw_s: dict[tuple[str, ...], float],
+                  dt: float | None, seq: int) -> None:
         """Per-link DCN fold: identical semantics to the slow ICI fold
         (monotonic with reset tolerance; rate only for links also seen at
         seq-1). Shares each chip's cached link-label-tuple dict with ICI —
@@ -1111,24 +1138,30 @@ class CollectorLoop:
     def _run_guarded(self) -> None:
         try:
             self._run()
-        except BaseException:  # noqa: BLE001 — thread-death supervision
+        except BaseException:  # noqa: BLE001  # lint: disable=bare-except(thread-death supervision: the ONE sanctioned poll-restart path — see class docstring)
+            # Decide + mutate under the lock; log AFTER release (lock-io
+            # discipline — log handlers do stream I/O, and stop() takes
+            # this lock on the SIGTERM drain path).
             with self._restart_lock:
                 if self._stop.is_set():
                     return
-                if self.restarts >= self.MAX_RESTARTS:
+                respawn = self.restarts < self.MAX_RESTARTS
+                if respawn:
+                    self.restarts += 1
+                    self._thread = self._spawn()
+                else:
                     self.dead = True
-                    log.critical(
-                        "poll loop died again (%d restart(s) used); staying "
-                        "down — /healthz reports 503", self.restarts,
-                        exc_info=True,
-                    )
-                    return
-                self.restarts += 1
+            if respawn:
                 log.critical(
                     "poll loop thread died unexpectedly; restarting (%d/%d)",
                     self.restarts, self.MAX_RESTARTS, exc_info=True,
                 )
-                self._thread = self._spawn()
+            else:
+                log.critical(
+                    "poll loop died again (%d restart(s) used); staying "
+                    "down — /healthz reports 503", self.restarts,
+                    exc_info=True,
+                )
 
     def _run(self) -> None:
         start = time.monotonic()

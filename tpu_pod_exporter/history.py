@@ -51,9 +51,12 @@ from __future__ import annotations
 import threading
 import time
 from array import array
-from typing import Mapping
+from typing import TYPE_CHECKING, Callable, Mapping
 
 from tpu_pod_exporter.metrics import schema
+
+if TYPE_CHECKING:  # typing only — no runtime import cost
+    from tpu_pod_exporter.metrics.registry import Snapshot
 
 # Metric families the collector feeds into history each poll. Info series
 # (tpu_host_info, tpu_exporter_info) and self-metrics are excluded — their
@@ -148,8 +151,8 @@ class HistoryStore:
         capacity: int = 301,
         max_series: int = 4096,
         retention_s: float = 300.0,
-        clock=time.monotonic,
-        wallclock=time.time,
+        clock: Callable[[], float] = time.monotonic,
+        wallclock: Callable[[], float] = time.time,
     ) -> None:
         if capacity < 2:
             raise ValueError("history capacity must be >= 2")
@@ -210,7 +213,7 @@ class HistoryStore:
             self._gc_locked(tm)
 
     def append_snapshot(
-        self, snapshot, now_mono: float, now_wall: float
+        self, snapshot: "Snapshot", now_mono: float, now_wall: float
     ) -> int:
         """Feed every tracked family of one collector snapshot; returns the
         number of samples appended. One lock acquisition for the whole poll.
@@ -335,7 +338,8 @@ class HistoryStore:
 
     def restore_series(
         self, metric: str, labels: Mapping[str, str],
-        samples: list[tuple[float, float]], wall_to_mono,
+        samples: list[tuple[float, float]],
+        wall_to_mono: Callable[[float], float],
     ) -> int:
         """Bulk-append persisted samples (oldest first) at boot. Monotonic
         timestamps are reconstructed from wall time via ``wall_to_mono``

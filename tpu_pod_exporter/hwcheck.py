@@ -117,7 +117,9 @@ class JaxStimulus:
             while self._burning.is_set():
                 burn_step(params, x, iters=20).block_until_ready()
 
-        self._thread = threading.Thread(target=burn, daemon=True)
+        self._thread = threading.Thread(
+            target=burn, name="tpu-hwcheck-burn", daemon=True
+        )
         self._thread.start()
 
     def stop(self) -> None:

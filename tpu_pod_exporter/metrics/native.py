@@ -8,8 +8,15 @@ back to the pure-Python formatter when ``render_lines`` returns None.
 from __future__ import annotations
 
 import ctypes
+from typing import TYPE_CHECKING
 
 from tpu_pod_exporter import nativelib
+
+if TYPE_CHECKING:  # typing only
+    from array import array
+
+    from tpu_pod_exporter.metrics.parse import LayoutCache
+    from tpu_pod_exporter.metrics.registry import FamilyLayout
 
 
 def render_lines(prefixes: list[bytes], values: list[float]) -> bytes | None:
@@ -29,7 +36,7 @@ def render_lines(prefixes: list[bytes], values: list[float]) -> bytes | None:
     return buf.raw[:written]
 
 
-def render_layout(layout, values) -> bytes | None:
+def render_layout(layout: "FamilyLayout", values: "array") -> bytes | None:
     """Render one family via its :class:`FamilyLayout`, reusing the ctypes
     pointer array across polls (building it is the per-call cost of
     ``render_lines``; the prefixes themselves are stable between churn
@@ -58,7 +65,7 @@ def render_layout(layout, values) -> bytes | None:
     return ctypes.string_at(buf, written)
 
 
-def parse_layout(layout, text: str) -> "list[float] | None":
+def parse_layout(layout: "LayoutCache", text: str) -> "list[float] | None":
     """Whole-body value-only parse of one exposition body against a warm
     :class:`~tpu_pod_exporter.metrics.parse.LayoutCache` — the parse-side
     inverse of :func:`render_layout`. Returns the kind-2 entry values in
@@ -94,6 +101,6 @@ def parse_layout(layout, text: str) -> "list[float] | None":
     return list(layout.native_out)
 
 
-def load():
+def load() -> "ctypes.CDLL | None":
     """Kept for tests: the shared library handle (or None)."""
     return nativelib.load()

@@ -213,7 +213,7 @@ def _parse_label_block(block: str, line: str) -> dict[str, str]:
     return cached
 
 
-def _parse_line(line: str, names):
+def _parse_line(line: str, names: "set[str] | frozenset[str] | None") -> tuple:
     """One stripped, non-empty, non-comment line → layout entry tuple:
     ``(1, prefix)`` when ``names`` filters the line out, else
     ``(2, prefix, name, labels, value)``. Raises ParseError. The SINGLE
@@ -351,7 +351,7 @@ class LayoutCache:
         self.samples_template = None
 
 
-def _native_parse_layout(layout, text):
+def _native_parse_layout(layout: "LayoutCache", text: str) -> "list[float] | None":
     try:
         from tpu_pod_exporter.metrics import native
     except ImportError:  # partial deployment: the parser must not die

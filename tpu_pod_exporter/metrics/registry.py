@@ -472,7 +472,7 @@ class Snapshot:
             body = self.encode_openmetrics()
             with self._gzip_lock:
                 if self._openmetrics_gzipped is None:
-                    self._openmetrics_gzipped = gzip.compress(body, compresslevel=1)
+                    self._openmetrics_gzipped = gzip.compress(body, compresslevel=1)  # lint: disable=lock-io(lazy once-per-snapshot cache; this lock exists to serialize exactly this compress, never taken by the poll thread)
         return self._openmetrics_gzipped
 
     def encode_gzip(self) -> bytes:
@@ -487,7 +487,7 @@ class Snapshot:
 
             with self._gzip_lock:
                 if self._gzipped is None:
-                    self._gzipped = gzip.compress(self.encode(), compresslevel=1)
+                    self._gzipped = gzip.compress(self.encode(), compresslevel=1)  # lint: disable=lock-io(lazy once-per-snapshot cache; this lock exists to serialize exactly this compress, never taken by the poll thread)
         return self._gzipped
 
 

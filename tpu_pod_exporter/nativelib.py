@@ -35,9 +35,22 @@ def _candidates():
 
 def load() -> ctypes.CDLL | None:
     global _lib, _tried
+    # Load outcomes are collected here and logged AFTER the lock releases
+    # (lock-io discipline): log handlers do stream I/O, and the first
+    # caller to race in during startup must not serialize behind it.
+    notes: list[tuple[int, str, tuple]] = []
     with _lock:
-        if _tried:
-            return _lib
+        lib = _load_locked(notes)
+    for level, fmt, args in notes:
+        log.log(level, fmt, *args)
+    return lib
+
+
+def _load_locked(notes: list) -> ctypes.CDLL | None:
+    """Candidate search + ABI check; caller holds ``_lock``. Messages are
+    appended to ``notes`` as (level, fmt, args) instead of logged."""
+    global _lib, _tried
+    if not _tried:
         _tried = True
         for cand in _candidates():
             if not cand.exists():
@@ -46,7 +59,10 @@ def load() -> ctypes.CDLL | None:
                 lib = ctypes.CDLL(str(cand))
                 lib.tpumon_abi_version.restype = ctypes.c_int
                 if lib.tpumon_abi_version() != ABI_VERSION:
-                    log.warning("%s: ABI version mismatch, ignoring", cand)
+                    notes.append((
+                        logging.WARNING, "%s: ABI version mismatch, ignoring",
+                        (cand,),
+                    ))
                     continue
                 lib.tpumon_count_devices.restype = ctypes.c_int
                 lib.tpumon_count_devices.argtypes = [ctypes.c_char_p]
@@ -91,11 +107,16 @@ def load() -> ctypes.CDLL | None:
                     ctypes.POINTER(ctypes.c_double),
                 ]
                 _lib = lib
-                log.info("libtpumon loaded from %s", cand)
+                notes.append((
+                    logging.INFO, "libtpumon loaded from %s", (cand,),
+                ))
                 break
             except (OSError, AttributeError) as e:
-                log.warning("cannot load native lib %s: %s", cand, e)
-        return _lib
+                notes.append((
+                    logging.WARNING, "cannot load native lib %s: %s",
+                    (cand, e),
+                ))
+    return _lib
 
 
 def reset_for_tests() -> None:

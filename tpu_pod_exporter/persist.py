@@ -62,6 +62,12 @@ import time
 import zlib
 from array import array
 from dataclasses import dataclass, field
+from typing import IO, TYPE_CHECKING, Any, Callable, Mapping
+
+if TYPE_CHECKING:  # typing only — no runtime import cost
+    from tpu_pod_exporter.history import HistoryStore
+    from tpu_pod_exporter.metrics.registry import Snapshot
+    from tpu_pod_exporter.supervisor import SourceSupervisor
 
 from tpu_pod_exporter.utils import RateLimitedLogger
 
@@ -92,7 +98,7 @@ WAL_NAME = "wal.bin"
 #   E  exposition: <d poll_timestamp> + raw exposition bytes
 
 
-def append_record(f, payload: bytes) -> int:
+def append_record(f: IO[bytes], payload: bytes) -> int:
     """Frame + write one record; returns bytes written (buffered, not
     synced — fsync cadence is the caller's policy)."""
     f.write(_HDR.pack(len(payload), zlib.crc32(payload)))
@@ -248,7 +254,7 @@ class RestoredSnapshot:
 
             with self._lock:
                 if self._gzipped is None:
-                    self._gzipped = gzip.compress(self._body, compresslevel=1)
+                    self._gzipped = gzip.compress(self._body, compresslevel=1)  # lint: disable=lock-io(lazy warm-start cache; lock serializes exactly this compress, restore-time only)
         return self._gzipped
 
     def encode_openmetrics(self) -> bytes:
@@ -267,7 +273,7 @@ class RestoredSnapshot:
             body = self.encode_openmetrics()
             with self._lock:
                 if self._openmetrics_gzipped is None:
-                    self._openmetrics_gzipped = gzip.compress(
+                    self._openmetrics_gzipped = gzip.compress(  # lint: disable=lock-io(lazy warm-start cache; lock serializes exactly this compress, restore-time only)
                         body, compresslevel=1
                     )
         return self._openmetrics_gzipped
@@ -309,14 +315,15 @@ class StatePersister:
     def __init__(
         self,
         state_dir: str,
-        history=None,
-        supervisors=None,
-        exposition_fn=None,  # () -> Snapshot-like (encode()/timestamp)
+        history: "HistoryStore | None" = None,
+        supervisors: Mapping[str, SourceSupervisor] | None = None,
+        # () -> Snapshot-like (encode()/timestamp)
+        exposition_fn: Callable[[], Any] | None = None,
         snapshot_interval_s: float = 60.0,
         fsync_interval_s: float = 5.0,
         queue_max: int = 8,
-        clock=time.monotonic,
-        wallclock=time.time,
+        clock: Callable[[], float] = time.monotonic,
+        wallclock: Callable[[], float] = time.time,
     ) -> None:
         self.state_dir = state_dir
         self.snapshot_path = os.path.join(state_dir, SNAPSHOT_NAME)
@@ -512,8 +519,10 @@ class StatePersister:
                 )
         return entries
 
-    def _flush_wal_batch(self, entries, acc, rs: RestoredState,
-                         wall_to_mono) -> None:
+    def _flush_wal_batch(self, entries: list[tuple[str, dict[str, str]]],
+                         acc: list[list[tuple[float, float]]],
+                         rs: RestoredState,
+                         wall_to_mono: Callable[[float], float]) -> None:
         if not entries or self._history is None:
             return
         for (metric, labels), samples in zip(entries, acc):
@@ -523,7 +532,7 @@ class StatePersister:
                 )
 
     def _apply_snapshot_record(self, payload: bytes, rs: RestoredState,
-                               wall_to_mono) -> None:
+                               wall_to_mono: Callable[[float], float]) -> None:
         kind = payload[:1]
         if kind == b"J":
             doc = json.loads(payload[1:])
@@ -567,7 +576,7 @@ class StatePersister:
         )
         self._thread.start()
 
-    def on_poll(self, snap) -> int:
+    def on_poll(self, snap: "Snapshot") -> int:
         """The poll thread's entire persistence cost: breaker-change
         signatures plus one non-blocking queue put (the snapshot is
         immutable — value extraction happens on the writer thread)."""
@@ -584,7 +593,7 @@ class StatePersister:
             queued = 1
         return queued
 
-    def _enqueue(self, item) -> bool:
+    def _enqueue(self, item: tuple) -> bool:
         try:
             self._q.put_nowait(item)
             return True
@@ -667,7 +676,7 @@ class StatePersister:
             self._wal = None
         done.set()
 
-    def _count_error(self, fmt: str, *args) -> None:
+    def _count_error(self, fmt: str, *args: object) -> None:
         with self._stats_lock:
             self._stats["errors"] += 1
         self._rlog.warning("persist_error", fmt, *args)
@@ -694,7 +703,7 @@ class StatePersister:
         with self._stats_lock:
             self._stats["wal_bytes"] = self._wal.tell()
 
-    def _write_item(self, item) -> None:
+    def _write_item(self, item: tuple) -> None:
         kind = item[0]
         if kind == "breaker":
             self._write_breaker(item[1])
@@ -729,7 +738,7 @@ class StatePersister:
             self._stats["wal_records"] += 1
             self._stats["wal_bytes"] += n
 
-    def _write_samples(self, snap) -> None:
+    def _write_samples(self, snap: "Snapshot") -> None:
         if not self._ensure_wal():
             return
         # Extract the tracked families from the (immutable) snapshot.
@@ -858,7 +867,8 @@ class BreakerStateFile:
     where a WAL would be overkill: the state is a handful of dicts that
     change on target transitions, not per round."""
 
-    def __init__(self, path: str, wallclock=time.time) -> None:
+    def __init__(self, path: str,
+                 wallclock: Callable[[], float] = time.time) -> None:
         self.path = path
         self._wallclock = wallclock
         try:
@@ -993,7 +1003,7 @@ def _overhead_check(polls: int, chips: int, budget: float) -> int:
 
     state_dir = tempfile.mkdtemp(prefix="tpe-persist-overhead-")
 
-    def make(with_persist: bool):
+    def make(with_persist: bool) -> tuple:
         history = HistoryStore(capacity=64, max_series=8192, retention_s=0.0)
         store = SnapshotStore()
         persister = None
@@ -1013,7 +1023,7 @@ def _overhead_check(polls: int, chips: int, budget: float) -> int:
             collector.poll_once()
         return collector, persister
 
-    def segment(collector, n) -> tuple[float, float]:
+    def segment(collector: Any, n: int) -> tuple[float, float]:
         t0 = time.thread_time()
         c0 = utils.process_cpu_seconds()
         for _ in range(n):
@@ -1058,7 +1068,7 @@ def _overhead_check(polls: int, chips: int, budget: float) -> int:
 # --------------------------------------------------------------- restart demo
 
 
-def _wait_http(url: str, timeout_s: float):
+def _wait_http(url: str, timeout_s: float) -> tuple[int, bytes]:
     """Poll a URL until it answers (any status); returns (status, body)."""
     import urllib.error
     import urllib.request
@@ -1084,7 +1094,7 @@ def _get_json(url: str, timeout_s: float = 10.0) -> dict:
     return json.loads(body)
 
 
-def _restart_demo(ns) -> int:
+def _restart_demo(ns: Any) -> int:
     """``make restart-demo``: the kill/restart chaos harness.
 
     Phase 1 runs a live exporter whose device source errors until the

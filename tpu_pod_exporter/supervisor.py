@@ -33,6 +33,7 @@ import queue
 import random
 import threading
 import time
+from typing import Any, Callable
 
 from tpu_pod_exporter import trace as trace_mod
 from tpu_pod_exporter.utils import RateLimitedLogger
@@ -86,7 +87,7 @@ class CircuitBreaker:
         backoff_base_s: float = 1.0,
         backoff_max_s: float = 30.0,
         jitter: float = 0.2,
-        clock=time.monotonic,
+        clock: Callable[[], float] = time.monotonic,
         rng: random.Random | None = None,
     ) -> None:
         if failure_threshold < 1:
@@ -147,7 +148,7 @@ class CircuitBreaker:
 
     # ------------------------------------------------- persistence (persist.py)
 
-    def export_state(self, wallclock=time.time) -> dict:
+    def export_state(self, wallclock: Callable[[], float] = time.time) -> dict:
         """Serializable breaker state for crash-safe persistence. The open
         window is exported as an absolute WALL deadline (``open_until_wall``)
         because the monotonic clock does not survive a restart."""
@@ -163,7 +164,7 @@ class CircuitBreaker:
             "transitions": dict(self.transitions),
         }
 
-    def restore_state(self, doc: dict, wallclock=time.time) -> None:
+    def restore_state(self, doc: dict, wallclock: Callable[[], float] = time.time) -> None:
         """Rehydrate from :meth:`export_state` output (defensively: the
         payload crossed a process death and a disk). A restored OPEN
         breaker keeps its remaining backoff window — the restarted process
@@ -223,7 +224,7 @@ class CircuitBreaker:
 class _Call:
     __slots__ = ("fn", "done", "result", "exc")
 
-    def __init__(self, fn) -> None:
+    def __init__(self, fn: Callable[[], Any]) -> None:
         self.fn = fn
         self.done = threading.Event()
         self.result = None
@@ -256,7 +257,7 @@ class _Worker:
                 return
             try:
                 call.result = call.fn()
-            except BaseException as e:  # noqa: BLE001 — relayed to the caller
+            except BaseException as e:  # noqa: BLE001  # lint: disable=bare-except(relayed to the supervised caller via call.exc and re-raised there; swallowing here would hang the deadline wait)
                 call.exc = e
             call.done.set()
             if self.fenced:
@@ -282,12 +283,12 @@ class SourceSupervisor:
     def __init__(
         self,
         source: str,
-        fn,
-        reconnect=None,
+        fn: Callable[[], Any],
+        reconnect: Callable[[], None] | None = None,
         deadline_s: float = 4.0,
         breaker: CircuitBreaker | None = None,
         max_abandoned: int = 8,
-        clock=time.monotonic,
+        clock: Callable[[], float] = time.monotonic,
     ) -> None:
         if deadline_s <= 0:
             raise ValueError("deadline_s must be positive")
@@ -314,7 +315,7 @@ class SourceSupervisor:
 
     # ------------------------------------------------------------------ call
 
-    def call(self):
+    def call(self) -> Any:
         """Run one supervised phase call; returns its result.
 
         Raises SourceSkipped (breaker open, backoff pending), SourceTimeout
@@ -347,7 +348,7 @@ class SourceSupervisor:
             # abandoned-worker cap is not counted as a reconnect.
             inner, reconnect = self._fn, self._reconnect
 
-            def fn():
+            def fn() -> Any:
                 self.reconnects += 1
                 reconnect()
                 return inner()
@@ -362,7 +363,7 @@ class SourceSupervisor:
         self.breaker.record_success()
         return result
 
-    def _submit(self, fn):
+    def _submit(self, fn: Callable[[], Any]) -> Any:
         self._prune_fenced()
         if len(self._fenced) >= self._max_abandoned:
             # Every abandoned worker is still blocked. Spawning another
@@ -385,7 +386,7 @@ class SourceSupervisor:
         if span is not None:
             inner = fn
 
-            def fn():
+            def fn() -> Any:
                 prev = trace_mod.swap_current(span)
                 try:
                     return inner()

@@ -51,6 +51,10 @@ import sys
 import threading
 import time
 from collections import deque
+from typing import TYPE_CHECKING, Callable, Sequence
+
+if TYPE_CHECKING:  # typing only — no runtime import cost
+    import types
 
 # Span statuses — the vocabulary the collector maps phase outcomes onto.
 OK = "ok"
@@ -191,9 +195,9 @@ class Span:
             ev = self.events = []
         if len(ev) >= MAX_SPAN_EVENTS:
             if ev[-1][1] != "…more events dropped":
-                ev.append((time.time() - self.t0_wall, "…more events dropped"))
+                ev.append((time.time() - self.t0_wall, "…more events dropped"))  # lint: disable=wall-clock(event stamps are wall offsets from the trace wall epoch by design)
             return
-        ev.append((time.time() - self.t0_wall, message))
+        ev.append((time.time() - self.t0_wall, message))  # lint: disable=wall-clock(event stamps are wall offsets from the trace wall epoch by design)
 
 
 class PollTrace:
@@ -207,7 +211,8 @@ class PollTrace:
     __slots__ = ("trace_id", "root", "spans", "profile", "profile_samples",
                  "slow", "_clock", "_wallclock")
 
-    def __init__(self, root_name: str, clock, wallclock) -> None:
+    def __init__(self, root_name: str, clock: Callable[[], float],
+                 wallclock: Callable[[], float]) -> None:
         self.trace_id = new_trace_id()
         self._clock = clock
         self._wallclock = wallclock
@@ -230,7 +235,7 @@ class PollTrace:
         self.spans.append(s)
         return s
 
-    def end_span(self, span: Span, status: str = OK, **attrs) -> None:
+    def end_span(self, span: Span, status: str = OK, **attrs: object) -> None:
         span.dur_s = self._clock() - span.t0_mono
         span.status = status
         if attrs:
@@ -243,7 +248,7 @@ class PollTrace:
         _tls.span = s
         return s
 
-    def end(self, status: str = OK, **attrs) -> None:
+    def end(self, status: str = OK, **attrs: object) -> None:
         s = getattr(_tls, "span", None)
         if s is None or s is self.root:
             return
@@ -273,7 +278,8 @@ class TraceStore:
     SCRAPE_RECORDS_PER_WINDOW = 64
 
     def __init__(self, max_traces: int = 256,
-                 max_scrape_spans: int = 512, clock=time.monotonic) -> None:
+                 max_scrape_spans: int = 512,
+                 clock: Callable[[], float] = time.monotonic) -> None:
         if max_traces < 1:
             raise ValueError("max_traces must be >= 1")
         self.max_traces = max_traces
@@ -300,7 +306,7 @@ class TraceStore:
                 self.slow_polls += 1
 
     def record_scrape(self, trace_id: str, parent_id: str, t0_wall: float,
-                      dur_s: float, **attrs) -> Span | None:
+                      dur_s: float, **attrs: object) -> Span | None:
         """Record a served-scrape span under a REMOTE trace context (from a
         ``traceparent`` header) — the join point the aggregator's round
         trace links to. Returns None when the record was dropped by the
@@ -361,7 +367,7 @@ class TraceStore:
 # ---------------------------------------------------- slow-poll profiler
 
 
-def _collapse(frame) -> str:
+def _collapse(frame: "types.FrameType | None") -> str:
     """One thread's stack as a collapsed ``mod.func;mod.func`` line,
     outermost first (the flamegraph folded format)."""
     out = []
@@ -402,7 +408,7 @@ class StackSampler:
     SCAN_PERIOD_S = 0.5
 
     def __init__(self, hz: float = 50.0, max_samples: int = 2048,
-                 clock=time.monotonic) -> None:
+                 clock: Callable[[], float] = time.monotonic) -> None:
         if hz <= 0:
             raise ValueError("hz must be positive")
         self.hz = hz
@@ -508,7 +514,8 @@ class Tracer:
 
     def __init__(self, store: TraceStore, slow_poll_s: float = 1.0,
                  sampler: StackSampler | None = None, root_name: str = "poll",
-                 clock=time.monotonic, wallclock=time.time) -> None:
+                 clock: Callable[[], float] = time.monotonic,
+                 wallclock: Callable[[], float] = time.time) -> None:
         self.store = store
         self.slow_poll_s = slow_poll_s
         self.root_name = root_name
@@ -526,7 +533,7 @@ class Tracer:
             self._sampler.arm(t, self.slow_poll_s)
         return t
 
-    def finish(self, trace: PollTrace, status: str = OK, **attrs) -> PollTrace:
+    def finish(self, trace: PollTrace, status: str = OK, **attrs: object) -> PollTrace:
         trace.end_span(trace.root, status, **attrs)
         if self._sampler is not None:
             self._sampler.disarm(trace)
@@ -546,7 +553,8 @@ class Tracer:
 # ------------------------------------------------------------ export/render
 
 
-def to_chrome_trace(traces, scrape_spans=()) -> dict:
+def to_chrome_trace(traces: Sequence[PollTrace],
+                    scrape_spans: Sequence[Span] = ()) -> dict:
     """Finished traces → a Chrome ``trace_event`` JSON document
     (chrome://tracing / Perfetto "JSON Array with metadata" flavor).
 
@@ -698,14 +706,14 @@ def _overhead_check(polls: int, chips: int, budget: float) -> int:
     # minutes of a real deployment, but most of a short bench run).
     ring = TraceStore(max_traces=32)
 
-    def make(tracer):
+    def make(tracer: Tracer | None) -> Collector:
         collector = Collector(FakeBackend(chips=chips), FakeAttribution(),
                               SnapshotStore(), tracer=tracer)
         for _ in range(50):  # warm caches/layouts; fill the trace ring
             collector.poll_once()
         return collector
 
-    def segment(collector, n) -> float:
+    def segment(collector: Collector, n: int) -> float:
         c0 = utils.process_cpu_seconds()
         for _ in range(n):
             collector.poll_once()
