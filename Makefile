@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -82,6 +82,16 @@ persist-fsync-check:
 # check also reports whole-process CPU for honesty.
 persist-overhead:
 	python -m tpu_pod_exporter.persist --overhead-check --polls 200 --chips 256 --budget 0.02
+
+# Federated query plane acceptance (deploy/RUNBOOK.md "Slice-wide
+# forensics"): 64 simulated exporters in one process, a real aggregator
+# fanning /api/v1/query_range out to all of them (tracing + persistence
+# ON), one target SIGKILL-shaped mid-run. Asserts the full merge with
+# per-target staleness, partial=true with the remaining 63 merged, and
+# the fleet-query p99 budget (CI runs with a wider budget for shared
+# runners — see .github/workflows/ci.yml).
+fleet-query-demo:
+	python -m tpu_pod_exporter.loadgen.fleet --targets 64 --budget-ms 1500
 
 native:
 	$(MAKE) -C native

@@ -15,6 +15,13 @@ that fails to scrape is reported down (``tpu_aggregator_target_up 0``) and
 its chips simply drop out of the sums for that round; partial slices stay
 honest via ``tpu_slice_hosts_reporting``.
 
+The aggregator also serves the **federated query plane**
+(``tpu_pod_exporter.fleet``, ``--fleet-query``): its own ``/api/v1/*``
+routes fan ``query_range``/``window_stats``/``series`` out to every
+non-quarantined target and merge per-series answers with partial-result
+semantics — one query shows a duty-cycle cliff across all 64 hosts of a
+slice, riding each node's history tiers hours back.
+
 Run: ``python -m tpu_pod_exporter.aggregate --targets h0:8000,h1:8000``.
 """
 
@@ -286,10 +293,19 @@ class SliceAggregator:
         breaker_backoff_max_s: float = 120.0,
         tracer=None,
         breaker_store=None,  # persist.BreakerStateFile; None = no persistence
+        fleet=None,  # fleet.FleetQueryPlane; publishes its self-metrics here
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
         self._targets = targets
+        # Federated /api/v1 query plane (tpu_pod_exporter.fleet): attached
+        # after construction (it shares this aggregator's breakers), it
+        # serves fan-out queries on HTTP handler threads; the round loop's
+        # only involvement is publishing its self-metrics and bumping
+        # `rounds` — the result cache's generation, so cached envelopes
+        # live exactly one round.
+        self._fleet = fleet
+        self.rounds = 0
         # Round tracing (tpu_pod_exporter.trace): one trace per round, one
         # span per target scrape / fallback / publish. The trace context
         # propagates onto the fan-out via a traceparent header — only when
@@ -380,10 +396,22 @@ class SliceAggregator:
             thread_name_prefix="tpu-agg-scrape",
         )
 
+    @property
+    def breakers(self) -> "dict[str, CircuitBreaker] | None":
+        """Per-target breaker map (None when disabled) — shared read-only
+        with the fleet query plane for its quarantine-aware skip."""
+        return self._breakers
+
+    def set_fleet(self, fleet) -> None:
+        """Attach the federated query plane (constructed after the
+        aggregator because it borrows the breaker map built here)."""
+        self._fleet = fleet
+
     # ------------------------------------------------------------------ round
 
     def poll_once(self) -> None:
         t0 = time.monotonic()
+        self.rounds += 1
         tr = self._tracer.start_poll() if self._tracer is not None else None
         # Round-local quarantine set: targets whose breaker skipped the
         # scrape entirely this round (set.add is GIL-atomic; each pool
@@ -738,6 +766,11 @@ class SliceAggregator:
                 b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
             b.add(schema.TPU_WORKLOAD_HOSTS, float(len(w.hosts)), key)
 
+        if self._fleet is not None:
+            try:
+                self._fleet.emit(b)
+            except Exception:  # noqa: BLE001 — accounting must never fail a round
+                pass
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         for lv, v in self._counters.items_for(
@@ -881,6 +914,11 @@ class SliceAggregator:
         return {
             "targets": list(self._targets),
             "timeout_s": self._timeout_s,
+            "rounds": self.rounds,
+            # Federated query plane occupancy (None = fleet queries off).
+            "fleet_query": (
+                self._fleet.stats() if self._fleet is not None else None
+            ),
             # Round-trace ring occupancy (None = tracing off); the traces
             # themselves are at GET /debug/trace.
             "trace": (
@@ -989,6 +1027,19 @@ def main(argv: list[str] | None = None) -> int:
                         "flight recorder (/api/v1/window_stats) over this "
                         "trailing window and fold the last-known chip data "
                         "into the rollups (0 disables; try 3x --interval-s)")
+    p.add_argument("--fleet-query", default="on", choices=("on", "off"),
+                   help="federated /api/v1 on this aggregator: "
+                        "query_range/window_stats/series fan out to every "
+                        "non-quarantined target, merge per series, and "
+                        "answer with partial-result semantics (a dead "
+                        "target degrades the answer, never fails it)")
+    p.add_argument("--fleet-query-timeout-s", type=float, default=0.0,
+                   help="per-target deadline for fleet query fan-out "
+                        "(default 0 = use --timeout-s)")
+    p.add_argument("--fleet-query-cache", type=int, default=128,
+                   help="fleet query result cache entries, keyed by "
+                        "(query, grid, round generation) — absorbs "
+                        "dashboard-refresh traffic (0 disables)")
     p.add_argument("--log-level", default="info")
     p.add_argument("--log-format", default="text", choices=("text", "json"),
                    help="json = one Cloud-Logging-shaped object per line")
@@ -1056,6 +1107,30 @@ def main(argv: list[str] | None = None) -> int:
         tracer=tracer,
         breaker_store=breaker_store,
     )
+    fleet = None
+    if ns.fleet_query == "on":
+        from tpu_pod_exporter.fleet import FleetQueryPlane
+
+        # Fleet query traces share the round-trace ring under their own
+        # root name, so /debug/trace shows rounds and queries side by side.
+        query_tracer = None
+        if trace_store is not None:
+            from tpu_pod_exporter.trace import Tracer
+
+            query_tracer = Tracer(trace_store, slow_poll_s=0.0,
+                                  root_name="query")
+        fleet = FleetQueryPlane(
+            targets,
+            timeout_s=(ns.fleet_query_timeout_s
+                       if ns.fleet_query_timeout_s > 0 else ns.timeout_s),
+            breakers=agg.breakers,
+            tracer=query_tracer,
+            cache_entries=ns.fleet_query_cache,
+            # Cache generation = round counter: one fan-out per query per
+            # round, however many dashboard panels refresh.
+            generation_fn=lambda: agg.rounds,
+        )
+        agg.set_fleet(fleet)
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
@@ -1064,6 +1139,7 @@ def main(argv: list[str] | None = None) -> int:
         debug_vars=agg.debug_vars,
         debug_addr=ns.debug_addr,
         trace=trace_store,
+        fleet=fleet,
     )
 
     stop = threading.Event()
@@ -1083,6 +1159,8 @@ def main(argv: list[str] | None = None) -> int:
     stop.wait()
     loop.stop()
     server.stop()
+    if fleet is not None:
+        fleet.close()
     agg.close()
     if recorder is not None:
         recorder.close()

@@ -274,7 +274,7 @@ class ExporterApp:
         # at the 300 s / 1 s / 8192-series defaults).
         self.history = None
         if cfg.history_retention_s > 0:
-            from tpu_pod_exporter.history import HistoryStore
+            from tpu_pod_exporter.history import HistoryStore, parse_tier_spec
 
             capacity = max(
                 2, min(int(cfg.history_retention_s / cfg.interval_s) + 1, 4096)
@@ -283,6 +283,9 @@ class ExporterApp:
                 capacity=capacity,
                 max_series=cfg.history_max_series,
                 retention_s=cfg.history_retention_s,
+                # Downsample tiers (--history-tiers): a bad spec must fail
+                # startup loudly, same as any other malformed flag.
+                tiers=parse_tier_spec(cfg.history_tiers),
             )
         # End-to-end poll tracing (tpu_pod_exporter.trace): per-phase spans
         # on every poll, a slow-poll stack profiler, and a bounded trace

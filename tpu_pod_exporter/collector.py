@@ -894,6 +894,16 @@ class Collector:
                 schema.TPU_EXPORTER_HISTORY_APPEND_SECONDS,
                 self._history_append_s,
             )
+            for tier in hs.get("tiers", ()):
+                lbl = (f"{tier['step_s']:g}",)
+                b.add(
+                    schema.TPU_EXPORTER_HISTORY_TIER_BUCKETS,
+                    float(tier["buckets"]), lbl,
+                )
+                b.add(
+                    schema.TPU_EXPORTER_HISTORY_TIER_SPAN_SECONDS,
+                    tier["span_s"], lbl,
+                )
 
         if self._persister is not None:
             # Point-in-time persistence accounting (one poll behind, like

@@ -103,6 +103,14 @@ class ExporterConfig:
     # never thrashes; memory is allocated per series actually present
     # (~32 MB at 256 chips, ~0.6 MB on a v4-8 host).
     history_max_series: int = 8192
+    # Multi-resolution downsample tiers behind the raw history ring:
+    # comma-separated step:capacity pairs (seconds:buckets). Each bucket
+    # folds counter-aware min/max/mean/first/last, so query_range answers
+    # hours-old ranges at 10 s/60 s resolution from the same bounded store
+    # (~48x the raw retention at the defaults, ~4x per-series memory —
+    # still hard-bounded by --history-max-series). "off" disables tiering
+    # (raw-ring-only, the pre-tier behaviour).
+    history_tiers: str = "10:60,60:240"
     # Crash-safe state persistence (tpu_pod_exporter.persist): directory
     # for the checksummed checkpoint + write-ahead log covering history
     # rings, breaker state, and the last published exposition. On boot the

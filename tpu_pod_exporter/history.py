@@ -25,6 +25,16 @@ Worst case: ``max_series × capacity × 24`` bytes plus per-series
 bookkeeping, allocated only for series actually present (~32 MB at the
 256-chip shape; the exporter defaults cap at 8192 × 301 × 24 ≈ 59 MB).
 
+Behind the raw ring sit **multi-resolution downsample tiers** (default
+10 s and 60 s buckets — :data:`DEFAULT_TIER_SPEC`): each bucket folds
+min/max/sum/count/first/last plus the within-bucket positive-delta sum, so
+both gauge statistics and counter-reset-tolerant rates recompute exactly
+from buckets. ``query_range`` transparently serves the coarsest tier that
+satisfies the requested step (escalating to a coarser tier when the
+requested start predates what the finer ring still holds), stretching
+answerable retention from minutes to hours inside the same
+``max_series`` hard bound; tier rings ride their series and evict with it.
+
 Query surface (served by ``server.py`` as ``/api/v1/*`` JSON):
 
 - ``series_list()`` — stored series and their label sets;
@@ -51,7 +61,7 @@ from __future__ import annotations
 import threading
 import time
 from array import array
-from typing import TYPE_CHECKING, Callable, Mapping
+from typing import TYPE_CHECKING, Callable, Mapping, Sequence
 
 from tpu_pod_exporter.metrics import schema
 
@@ -101,6 +111,205 @@ def is_counter_metric(name: str) -> bool:
     return name.endswith("_total")
 
 
+# Multi-resolution downsample tiers behind the raw ring: ``step:capacity``
+# pairs, finest first. Defaults stretch query_range's answerable retention
+# from 5 min of raw (301 × 1 s polls) to 4 h (240 × 60 s buckets) — 48×, at
+# the same ``--history-max-series`` series bound. Each bucket keeps
+# min/max/sum/count/first/last plus the within-bucket positive-delta sum,
+# so gauge stats AND counter-reset-tolerant rates recompute exactly from
+# tier buckets (asserted by tests/test_tiers.py property tests).
+DEFAULT_TIER_SPEC = "10:60,60:240"
+
+# Per finalized bucket: 11 float64 cells (4 timestamps + 7 value stats).
+_TIER_BUCKET_BYTES = 11 * 8
+
+
+def parse_tier_spec(spec: str) -> tuple[tuple[float, int], ...]:
+    """``"10:60,60:240"`` → ``((10.0, 60), (60.0, 240))``, sorted finest
+    first. Empty / ``"off"`` / ``"none"`` disables tiering entirely."""
+    s = spec.strip().lower()
+    if s in ("", "off", "none", "0"):
+        return ()
+    tiers: list[tuple[float, int]] = []
+    for entry in s.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        step_s, _, cap_s = entry.partition(":")
+        try:
+            step = float(step_s)
+            cap = int(cap_s) if cap_s else 0
+        except ValueError as e:
+            raise ValueError(f"bad tier entry {entry!r}: {e}") from e
+        if step <= 0 or cap < 2:
+            raise ValueError(
+                f"bad tier entry {entry!r}: need step > 0 and capacity >= 2"
+            )
+        tiers.append((step, cap))
+    tiers.sort()
+    if len({step for step, _cap in tiers}) != len(tiers):
+        raise ValueError(f"duplicate tier step in {spec!r}")
+    return tuple(tiers)
+
+
+class _TierRing:
+    """One series' downsample ring for one tier: a fixed-capacity ring of
+    finalized buckets plus one open accumulator bucket.
+
+    Buckets are keyed by wall time (``t_wall // step``) so bucket edges
+    line up with the wall-clock grids queries ask for. Per finalized
+    bucket the ring stores first/last mono+wall timestamps and
+    min/max/sum/count/first/last values, plus ``dpos`` — the sum of
+    positive deltas between consecutive samples *within* the bucket. The
+    cross-bucket boundary delta is recomputed at query time from
+    ``vfirst[k] − vlast[k−1]``, so a window rate over whole buckets equals
+    the raw-sample computation exactly (reset tolerance included) without
+    the ring needing to know its neighbours at append time."""
+
+    __slots__ = ("step", "cap", "n", "head",
+                 "tmf", "tml", "twf", "twl",
+                 "vmin", "vmax", "vsum", "vcnt", "vfirst", "vlast", "dpos",
+                 "bucket", "a_tmf", "a_tml", "a_twf", "a_twl", "a_min",
+                 "a_max", "a_sum", "a_cnt", "a_first", "a_last", "a_dpos")
+
+    def __init__(self, step: float, cap: int) -> None:
+        zeros = bytes(8 * cap)
+        self.step = step
+        self.cap = cap
+        self.n = 0
+        self.head = 0
+        self.tmf = array("d", zeros)
+        self.tml = array("d", zeros)
+        self.twf = array("d", zeros)
+        self.twl = array("d", zeros)
+        self.vmin = array("d", zeros)
+        self.vmax = array("d", zeros)
+        self.vsum = array("d", zeros)
+        self.vcnt = array("d", zeros)
+        self.vfirst = array("d", zeros)
+        self.vlast = array("d", zeros)
+        self.dpos = array("d", zeros)
+        self.bucket = -1  # open-bucket id; -1 = nothing accumulated yet
+        self.a_tmf = 0.0
+        self.a_tml = 0.0
+        self.a_twf = 0.0
+        self.a_twl = 0.0
+        self.a_min = 0.0
+        self.a_max = 0.0
+        self.a_sum = 0.0
+        self.a_cnt = 0
+        self.a_first = 0.0
+        self.a_last = 0.0
+        self.a_dpos = 0.0
+
+    def add(self, t_mono: float, t_wall: float, v: float, dpos: float) -> None:
+        b = int(t_wall // self.step)
+        if b != self.bucket:
+            if self.bucket >= 0:
+                self._flush()
+            self.bucket = b
+            self.a_tmf = t_mono
+            self.a_twf = t_wall
+            self.a_min = v
+            self.a_max = v
+            self.a_sum = v
+            self.a_cnt = 1
+            self.a_first = v
+            # The boundary delta (previous bucket's last → this sample) is
+            # deliberately NOT accumulated: queries rebuild it from the
+            # stored first/last values of adjacent buckets, keeping window
+            # rates exact from any bucket onward.
+            self.a_dpos = 0.0
+        else:
+            if v < self.a_min:
+                self.a_min = v
+            if v > self.a_max:
+                self.a_max = v
+            self.a_sum += v
+            self.a_cnt += 1
+            self.a_dpos += dpos
+        self.a_tml = t_mono
+        self.a_twl = t_wall
+        self.a_last = v
+
+    def _flush(self) -> None:
+        i = self.head
+        self.tmf[i] = self.a_tmf
+        self.tml[i] = self.a_tml
+        self.twf[i] = self.a_twf
+        self.twl[i] = self.a_twl
+        self.vmin[i] = self.a_min
+        self.vmax[i] = self.a_max
+        self.vsum[i] = self.a_sum
+        self.vcnt[i] = float(self.a_cnt)
+        self.vfirst[i] = self.a_first
+        self.vlast[i] = self.a_last
+        self.dpos[i] = self.a_dpos
+        self.head = (i + 1) % self.cap
+        if self.n < self.cap:
+            self.n += 1
+
+    # Query-side copy, called UNDER the store lock (same raw-slice
+    # discipline as HistoryStore._rows_for): finalized buckets as array
+    # slices plus the open accumulator as one tuple; per-bucket Python
+    # tuples are built outside the lock by _tier_items.
+    def copy(self) -> tuple:
+        open_bucket = None
+        if self.bucket >= 0 and self.a_cnt > 0:
+            open_bucket = (self.a_tmf, self.a_tml, self.a_twf, self.a_twl,
+                           self.a_min, self.a_max, self.a_sum,
+                           float(self.a_cnt), self.a_first, self.a_last,
+                           self.a_dpos)
+        return (self.step, self.cap, self.n, self.head,
+                self.tmf[:], self.tml[:], self.twf[:], self.twl[:],
+                self.vmin[:], self.vmax[:], self.vsum[:], self.vcnt[:],
+                self.vfirst[:], self.vlast[:], self.dpos[:], open_bucket)
+
+    def oldest_mono(self) -> float:
+        """Earliest t_mono this ring can answer for; -inf when the ring has
+        not wrapped yet (it then holds everything since series creation)."""
+        if self.n < self.cap:
+            return float("-inf")
+        return self.tmf[(self.head - self.n) % self.cap]
+
+    def oldest_wall(self) -> float:
+        if self.n < self.cap:
+            return float("-inf")
+        return self.twf[(self.head - self.n) % self.cap]
+
+    def newest_wall(self) -> float:
+        if self.bucket >= 0 and self.a_cnt > 0:
+            return self.a_twl
+        if self.n:
+            return self.twl[(self.head - 1) % self.cap]
+        return float("-inf")
+
+    def first_wall(self) -> float:
+        """Wall time of the oldest retained bucket's first sample (+inf when
+        empty) — the occupancy/span read, not the coverage read."""
+        if self.n:
+            return self.twf[(self.head - self.n) % self.cap]
+        if self.bucket >= 0 and self.a_cnt > 0:
+            return self.a_twf
+        return float("inf")
+
+
+def _tier_items(copy: tuple) -> list[tuple]:
+    """One copied tier ring's buckets, oldest first (open bucket last), as
+    (tmf, tml, twf, twl, vmin, vmax, vsum, vcnt, vfirst, vlast, dpos)."""
+    (_step, cap, n, head, tmf, tml, twf, twl,
+     vmin, vmax, vsum, vcnt, vfirst, vlast, dpos, open_bucket) = copy
+    start = (head - n) % cap
+    items = [
+        (tmf[i], tml[i], twf[i], twl[i], vmin[i], vmax[i], vsum[i],
+         vcnt[i], vfirst[i], vlast[i], dpos[i])
+        for i in ((start + k) % cap for k in range(n))
+    ]
+    if open_bucket is not None:
+        items.append(open_bucket)
+    return items
+
+
 class _Series:
     """One series' identity plus its fixed-capacity ring of
     (t_mono, t_wall, value) float64 triples.
@@ -113,9 +322,10 @@ class _Series:
     per-sample method call there is the dominant cost (measured)."""
 
     __slots__ = ("name", "labels", "cap", "n", "head", "tm", "tw", "vals",
-                 "last_mono")
+                 "last_mono", "tiers", "pv")
 
-    def __init__(self, name: str, labels: dict[str, str], cap: int) -> None:
+    def __init__(self, name: str, labels: dict[str, str], cap: int,
+                 tier_spec: tuple[tuple[float, int], ...] = ()) -> None:
         zeros = bytes(8 * cap)
         self.name = name
         self.labels = labels
@@ -126,6 +336,12 @@ class _Series:
         self.tw = array("d", zeros)
         self.vals = array("d", zeros)
         self.last_mono = 0.0
+        # Downsample rings (finest first) + the previous raw value, from
+        # which each sample's positive delta (the counter-rate unit) is
+        # derived once and fed to every tier. NaN start: `v - nan > 0` is
+        # False, so the first sample contributes dpos 0 with no branch.
+        self.tiers = tuple(_TierRing(step, tcap) for step, tcap in tier_spec)
+        self.pv = float("nan")
 
     def append(self, t_mono: float, t_wall: float, value: float) -> None:
         i = self.head
@@ -137,8 +353,19 @@ class _Series:
             self.n += 1
         self.last_mono = t_mono
 
+    def tier_add(self, t_mono: float, t_wall: float, value: float) -> None:
+        d = value - self.pv
+        dpos = d if d > 0.0 else 0.0
+        self.pv = value
+        for t in self.tiers:
+            t.add(t_mono, t_wall, value, dpos)
+
 class HistoryStore:
     """Bounded multi-series ring-buffer store with a query API.
+
+    Tier occupancy in :meth:`stats` refreshes at most every
+    ``_TIER_STATS_INTERVAL_S`` (spans move one bucket per tier step, so a
+    staler read is indistinguishable almost always).
 
     Thread contract: ``append*`` is called by the poll thread (one lock
     acquisition per poll, after the snapshot swap — never on the scrape
@@ -153,6 +380,7 @@ class HistoryStore:
         retention_s: float = 300.0,
         clock: Callable[[], float] = time.monotonic,
         wallclock: Callable[[], float] = time.time,
+        tiers: Sequence[tuple[float, int]] | str | None = None,
     ) -> None:
         if capacity < 2:
             raise ValueError("history capacity must be >= 2")
@@ -161,6 +389,18 @@ class HistoryStore:
         self.capacity = capacity
         self.max_series = max_series
         self.retention_s = retention_s
+        # Downsample tiers (None = the default 10 s/60 s pair; () or "off"
+        # disables). Tier rings ride each series and are evicted with it;
+        # they stretch query_range's answerable retention ~48× at the cost
+        # of ~4× per-series memory (see DEFAULT_TIER_SPEC), still
+        # hard-bounded by max_series.
+        if tiers is None:
+            self.tier_spec = parse_tier_spec(DEFAULT_TIER_SPEC)
+        elif isinstance(tiers, str):
+            self.tier_spec = parse_tier_spec(tiers)
+        else:
+            self.tier_spec = tuple(sorted(tiers))
+        self._tiering = bool(self.tier_spec)
         self._clock = clock
         self._wallclock = wallclock
         self._lock = threading.Lock()
@@ -192,6 +432,10 @@ class HistoryStore:
         # most ~3% past its retention — invisible at query granularity.
         self._gc_interval_s = max(1.0, retention_s / 32.0)
         self._last_gc = 0.0
+        # Tier occupancy stats are a full scan too (see _tier_stats_locked)
+        # — same amortization discipline.
+        self._tier_stats_cache: list[dict] | None = None
+        self._tier_stats_at = 0.0
 
     # ---------------------------------------------------------------- append
 
@@ -222,6 +466,7 @@ class HistoryStore:
         the inlined zip loop over cached _Series objects; any churn falls
         back to the keyed path for that family and rebuilds its layout."""
         appended = 0
+        tiering = self._tiering
         with self._lock:
             layouts = self._layouts
             for name in HISTORY_TRACKED_METRICS:
@@ -246,6 +491,8 @@ class HistoryStore:
                             s.n += 1
                             new_samples += 1
                         s.last_mono = now_mono
+                        if tiering:
+                            s.tier_add(now_mono, now_wall, v)
                     self._samples += new_samples
                     appended += len(keys)
                     continue
@@ -264,6 +511,8 @@ class HistoryStore:
                     if s.n != s.cap:
                         self._samples += 1
                     s.append(now_mono, now_wall, value)
+                    if tiering:
+                        s.tier_add(now_mono, now_wall, value)
                     series_list.append(s)
                     appended += 1
                 if self._evict_gen == gen0:
@@ -283,6 +532,8 @@ class HistoryStore:
         if s.n != s.cap:
             self._samples += 1
         s.append(tm, tw, value)
+        if self._tiering:
+            s.tier_add(tm, tw, value)
 
     def _create_locked(self, key: tuple, metric: str,
                        labels: dict[str, str]) -> _Series:
@@ -293,7 +544,8 @@ class HistoryStore:
             self._evicted["capacity"] += 1
             self._evict_gen += 1
             self._layouts.clear()  # a layout may still reference the victim
-        s = self._series[key] = _Series(metric, labels, self.capacity)
+        s = self._series[key] = _Series(metric, labels, self.capacity,
+                                        self.tier_spec)
         return s
 
     def _gc_locked(self, now_mono: float) -> None:
@@ -375,6 +627,48 @@ class HistoryStore:
     def _matches(labels: dict[str, str], match: Mapping[str, str]) -> bool:
         return all(labels.get(k) == v for k, v in match.items())
 
+    @staticmethod
+    def _choose_ring(s: _Series, step: float, start: float,
+                     wall_axis: bool, escalate: bool = True) -> int:
+        """Tier selection for one series: index into ``s.tiers`` or -1 for
+        the raw ring.
+
+        Two rules, in order: (1) the COARSEST ring whose resolution still
+        satisfies the requested step (raw when ``step`` is 0 or finer than
+        every tier) — the transparent-downsample contract; (2) coverage
+        escalation (``escalate``; off for raw-sample queries, whose
+        contract is "the raw ring, whatever it still holds"): if the
+        chosen ring has already evicted ``start``, prefer the finest
+        coarser ring that still reaches it, else whichever ring reaches
+        furthest back — answering an old incident window at 60 s
+        resolution beats answering nothing. A ring that has not wrapped
+        yet holds everything since series creation and always covers."""
+        choice = -1
+        if step > 0:
+            for i, t in enumerate(s.tiers):
+                if t.step <= step:
+                    choice = i
+
+        def oldest(idx: int) -> float:
+            if idx < 0:
+                if s.n < s.cap:
+                    return float("-inf")
+                j = (s.head - s.n) % s.cap
+                return s.tw[j] if wall_axis else s.tm[j]
+            t = s.tiers[idx]
+            return t.oldest_wall() if wall_axis else t.oldest_mono()
+
+        if not escalate or oldest(choice) <= start:
+            return choice
+        best, best_oldest = choice, oldest(choice)
+        for i in range(choice + 1, len(s.tiers)):
+            ow = oldest(i)
+            if ow <= start:
+                return i
+            if ow < best_oldest:
+                best, best_oldest = i, ow
+        return best
+
     def _rows_for(self, metric: str, match: Mapping[str, str]) -> list[tuple]:
         """Matching series' ring contents, copied out under the lock as raw
         ``array('d')`` slices — C-speed memcpy, ~7 KB per series. The
@@ -389,6 +683,40 @@ class HistoryStore:
                 for s in self._series.values()
                 if s.name == metric and self._matches(s.labels, match)
             ]
+
+    def _query_rows(self, metric: str, match: Mapping[str, str],
+                    step: float, start: float, wall_axis: bool,
+                    escalate: bool = True) -> list[tuple]:
+        """Tier-aware row copies for one query: per matching series, pick
+        the ring :meth:`_choose_ring` selects and copy ONLY that ring
+        (copying every tier of every series would multiply the under-lock
+        memcpy ~4×, paid by raw-only queries that never read it). Each row
+        is ``(labels, tier_step, payload, last_wall)`` where tier_step is
+        0.0 for the raw ring and payload is the matching ring copy;
+        last_wall is the series' newest raw sample wall time — the
+        staleness stamp every query answer now carries."""
+        with self._lock:
+            rows: list[tuple] = []
+            for s in self._series.values():
+                if s.name != metric or not self._matches(s.labels, match):
+                    continue
+                last_wall = (
+                    s.tw[(s.head - 1) % s.cap] if s.n else None
+                )
+                idx = (
+                    self._choose_ring(s, step, start, wall_axis, escalate)
+                    if s.tiers else -1
+                )
+                if idx < 0:
+                    payload: tuple = (
+                        s.labels, s.cap, s.n, s.head,
+                        s.tm[:], s.tw[:], s.vals[:],
+                    )
+                    rows.append((s.labels, 0.0, payload, last_wall))
+                else:
+                    t = s.tiers[idx]
+                    rows.append((s.labels, t.step, t.copy(), last_wall))
+            return rows
 
     @staticmethod
     def _row_items(row: tuple) -> list[tuple[float, float, float]]:
@@ -408,6 +736,21 @@ class HistoryStore:
                 for s in self._series.values()
             ]
 
+    # Per-bucket value picks for tier-backed query_range grids. A bucket
+    # tuple is (tmf, tml, twf, twl, vmin, vmax, vsum, vcnt, vfirst, vlast,
+    # dpos) — see _tier_items.
+    QUERY_AGGS: tuple[str, ...] = ("last", "min", "max", "mean")
+
+    @staticmethod
+    def _bucket_value(b: tuple, agg: str) -> float:
+        if agg == "min":
+            return b[4]
+        if agg == "max":
+            return b[5]
+        if agg == "mean":
+            return b[6] / b[7] if b[7] else b[9]
+        return b[9]  # last
+
     def query_range(
         self,
         metric: str,
@@ -415,6 +758,7 @@ class HistoryStore:
         start: float | None = None,
         end: float | None = None,
         step: float = 0.0,
+        agg: str = "last",
     ) -> list[dict]:
         """Samples of every matching series with wall time in [start, end].
 
@@ -422,27 +766,48 @@ class HistoryStore:
         ``start, start+step, …, end``, each point carrying the most recent
         sample at or before it (within a ``max(2*step, 10 s)`` staleness
         lookback, so a long-dead series doesn't project forward forever).
+
+        The backing ring is chosen per series (:meth:`_choose_ring`): the
+        coarsest downsample tier whose resolution satisfies ``step``, with
+        coverage escalation when the requested ``start`` predates what the
+        finer ring still holds — one query spans hours without the caller
+        knowing tiers exist. Tier-backed answers expose per-bucket ``agg``
+        (last/min/max/mean; a duty-cycle cliff hunts with ``agg=min``);
+        each result row carries ``tier`` (the bucket width served, 0 =
+        raw) and ``last_sample_wall_ts`` (the series' newest sample — the
+        staleness stamp federation merges key on).
         """
         if end is None:
             end = self._wallclock()
         if start is None:
             start = end - 300.0
         out: list[dict] = []
-        for row in self._rows_for(metric, match or {}):
-            labels = row[0]
-            items = self._row_items(row)
+        for labels, tier_step, payload, last_wall in self._query_rows(
+            metric, match or {}, step, start, True, escalate=step > 0
+        ):
+            if tier_step == 0.0:
+                items = self._row_items(payload)
+                points = [(tw, v) for (_tm, tw, v) in items]
+            else:
+                points = [
+                    (b[3], self._bucket_value(b, agg))
+                    for b in _tier_items(payload)
+                ]
             if step > 0:
                 # Grid alignment carries the most recent sample at or
                 # before each point, so samples just BEFORE `start` are
                 # still eligible for the left-edge grid points (within the
                 # lookback) — filtering them out would fake a gap at the
                 # start of an incident window.
-                raw = [(tw, v) for (_tm, tw, v) in items if tw <= end]
-                lookback = max(2.0 * step, 10.0)
+                raw = [(tw, v) for (tw, v) in points if tw <= end]
+                # Lookback floor tracks the bucket width on tier-backed
+                # answers: a 60 s bucket's single point must carry grid
+                # points across its whole bucket, not just 10 s of it.
+                lookback = max(2.0 * step, 2.0 * tier_step, 10.0)
                 aligned: list[list[float]] = []
                 i = -1
                 t = start
-                # one forward pointer walk: raw is time-ordered
+                # one forward pointer walk: points are time-ordered
                 while t <= end + 1e-9:
                     while i + 1 < len(raw) and raw[i + 1][0] <= t:
                         i += 1
@@ -452,12 +817,14 @@ class HistoryStore:
                 values = aligned
             else:
                 values = [
-                    [tw, v] for (_tm, tw, v) in items if start <= tw <= end
+                    [tw, v] for (tw, v) in points if start <= tw <= end
                 ]
             if values:
-                out.append(
-                    {"metric": metric, "labels": dict(labels), "values": values}
-                )
+                out.append({
+                    "metric": metric, "labels": dict(labels),
+                    "values": values, "tier": tier_step,
+                    "last_sample_wall_ts": last_wall,
+                })
         return out
 
     def window_stats(
@@ -471,46 +838,132 @@ class HistoryStore:
         counter-aware ``rate`` (sum of positive deltas / elapsed — the
         ICI/DCN monotonic-fold semantics: a device reset holds, it never
         goes negative). ``rate`` is null for gauges and for windows with
-        fewer than two samples."""
+        fewer than two samples.
+
+        Windows reaching past raw retention fold downsample-tier buckets
+        instead: min/mean/max/first/last and sample counts recompute
+        exactly from per-bucket stats, and the counter rate rebuilds
+        cross-bucket boundary deltas from adjacent buckets' first/last
+        values, so reset tolerance survives downsampling (a window edge
+        mid-bucket includes that whole bucket — bucket-width granularity,
+        not sample loss). Rows carry ``tier`` and ``last_sample_wall_ts``
+        like :meth:`query_range`."""
         now = self._clock() if now_mono is None else now_mono
         lo = now - window_s
         counter = is_counter_metric(metric)
         out: list[dict] = []
-        for row in self._rows_for(metric, match or {}):
-            labels = row[0]
-            items = self._row_items(row)
-            win = [(tm, tw, v) for (tm, tw, v) in items if tm >= lo]
-            if not win:
-                continue
-            vals = [v for (_tm, _tw, v) in win]
-            stats = {
-                "min": min(vals),
-                "max": max(vals),
-                "mean": sum(vals) / len(vals),
-                "first": vals[0],
-                "last": vals[-1],
-                "first_t": win[0][1],
-                "last_t": win[-1][1],
-                "samples": len(vals),
-                "rate": None,
-            }
-            if counter and len(win) >= 2:
-                dt = win[-1][0] - win[0][0]
-                if dt > 0:
-                    gained = sum(
-                        d for d in
-                        (b - a for a, b in zip(vals, vals[1:]))
-                        if d > 0
-                    )
-                    stats["rate"] = gained / dt
-            out.append({"metric": metric, "labels": dict(labels), "stats": stats})
+        for labels, tier_step, payload, last_wall in self._query_rows(
+            metric, match or {}, 0.0, lo, False
+        ):
+            stats: dict[str, float | int | None]
+            if tier_step == 0.0:
+                items = self._row_items(payload)
+                win = [(tm, tw, v) for (tm, tw, v) in items if tm >= lo]
+                if not win:
+                    continue
+                vals = [v for (_tm, _tw, v) in win]
+                stats = {
+                    "min": min(vals),
+                    "max": max(vals),
+                    "mean": sum(vals) / len(vals),
+                    "first": vals[0],
+                    "last": vals[-1],
+                    "first_t": win[0][1],
+                    "last_t": win[-1][1],
+                    "samples": len(vals),
+                    "rate": None,
+                }
+                if counter and len(win) >= 2:
+                    dt = win[-1][0] - win[0][0]
+                    if dt > 0:
+                        gained = sum(
+                            d for d in
+                            (b - a for a, b in zip(vals, vals[1:]))
+                            if d > 0
+                        )
+                        stats["rate"] = gained / dt
+            else:
+                buckets = [
+                    b for b in _tier_items(payload) if b[1] >= lo
+                ]  # bucket's last sample inside the window
+                if not buckets:
+                    continue
+                nsamples = int(sum(b[7] for b in buckets))
+                stats = {
+                    "min": min(b[4] for b in buckets),
+                    "max": max(b[5] for b in buckets),
+                    "mean": sum(b[6] for b in buckets) / nsamples,
+                    "first": buckets[0][8],
+                    "last": buckets[-1][9],
+                    "first_t": buckets[0][2],
+                    "last_t": buckets[-1][3],
+                    "samples": nsamples,
+                    "rate": None,
+                }
+                if counter and nsamples >= 2:
+                    dt = buckets[-1][1] - buckets[0][0]
+                    if dt > 0:
+                        gained = sum(b[10] for b in buckets)
+                        for prev, cur in zip(buckets, buckets[1:]):
+                            d = cur[8] - prev[9]  # boundary: first - prev last
+                            if d > 0:
+                                gained += d
+                        stats["rate"] = gained / dt
+            out.append({
+                "metric": metric, "labels": dict(labels), "stats": stats,
+                "tier": tier_step, "last_sample_wall_ts": last_wall,
+            })
         return out
 
     # ----------------------------------------------------------- introspection
 
+    _TIER_STATS_INTERVAL_S = 10.0
+
+    def _tier_stats_locked(self) -> list[dict]:
+        """Per-tier occupancy/span, amortized: the full O(series × tiers)
+        scan runs at most once per _TIER_STATS_INTERVAL_S and is cached —
+        the collector reads stats() EVERY poll, and spans move one bucket
+        per tier-step anyway, so a freshly scanned answer would be
+        identical almost every time while holding the append lock longer."""
+        now = self._clock()
+        if (self._tier_stats_cache is not None
+                and now - self._tier_stats_at < self._TIER_STATS_INTERVAL_S):
+            return self._tier_stats_cache
+        tiers: list[dict] = []
+        for i, (step, cap) in enumerate(self.tier_spec):
+            buckets = 0
+            oldest = float("inf")
+            newest = float("-inf")
+            for s in self._series.values():
+                t = s.tiers[i]
+                buckets += t.n + (1 if t.bucket >= 0 and t.a_cnt else 0)
+                fw = t.first_wall()
+                if fw < oldest:
+                    oldest = fw
+                nw = t.newest_wall()
+                if nw > newest:
+                    newest = nw
+            tiers.append({
+                "step_s": step,
+                "capacity": cap,
+                "buckets": buckets,
+                # Answerable span: how far back this tier can currently
+                # reach — the occupancy read the Grafana row plots.
+                "span_s": max(newest - oldest, 0.0) if buckets else 0.0,
+            })
+        self._tier_stats_cache = tiers
+        self._tier_stats_at = now
+        return tiers
+
     def stats(self) -> dict:
         with self._lock:
             nseries = len(self._series)
+            # Three float64 arrays per raw ring plus 11 per tier bucket,
+            # all preallocated at full capacity per series present.
+            per_series = self.capacity * 24 + sum(
+                cap * _TIER_BUCKET_BYTES for _step, cap in self.tier_spec
+            )
+            tiers = self._tier_stats_locked()
             return {
                 "series": nseries,
                 "samples": self._samples,
@@ -518,8 +971,8 @@ class HistoryStore:
                 "capacity": self.capacity,
                 "max_series": self.max_series,
                 "retention_s": self.retention_s,
-                # three float64 arrays per ring, allocated at full capacity
-                "memory_bytes": nseries * self.capacity * 24,
+                "memory_bytes": nseries * per_series,
+                "tiers": tiers,
             }
 
 

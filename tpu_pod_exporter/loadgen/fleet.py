@@ -1,0 +1,374 @@
+"""Fleet-mode load generation: N simulated exporters in one process.
+
+The other loadgen modes drive real accelerators; this one drives the
+*observability plane at fleet shape*. It runs N lightweight simulated
+exporters (real ``Collector`` + ``HistoryStore`` + ``MetricsServer`` over a
+scripted ``FakeBackend``, each on its own ephemeral port with a distinct
+host topology) inside one process, so tests and CI can stand up a 64-host
+slice in a couple of seconds and point a real aggregator at it.
+
+``python -m tpu_pod_exporter.loadgen.fleet`` is the fleet-query acceptance
+harness (``make fleet-query-demo``): it builds the fleet, aggregates it,
+runs federated ``/api/v1/query_range`` queries through the real HTTP
+stack with tracing and persistence ON, kills one target mid-run, and
+asserts (1) a full merge with per-target staleness, (2) ``partial: true``
+with the remaining targets merged after the kill, and (3) a fleet-query
+p99 latency budget — the CI gate for the federated query plane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import threading
+import time
+import urllib.request
+
+
+def _build_exporter(idx: int, chips: int, state_dir: str | None,
+                    trace: bool):
+    """One simulated exporter: scripted fake backend, real collector,
+    history (tiers on), optional persistence, HTTP server on port 0."""
+    from tpu_pod_exporter.attribution.fake import FakeAttribution
+    from tpu_pod_exporter.backend.fake import FakeBackend, FakeChipScript
+    from tpu_pod_exporter.collector import Collector
+    from tpu_pod_exporter.history import HistoryStore
+    from tpu_pod_exporter.metrics import SnapshotStore
+    from tpu_pod_exporter.server import MetricsServer
+    from tpu_pod_exporter.topology import detect_host_topology
+
+    # Distinct, deterministic telemetry per host so merged fleet answers
+    # are checkable: duty ramps with the poll index offset by host, HBM
+    # grows host-dependently.
+    script = FakeChipScript(
+        hbm_used_bytes=lambda step, i=idx: float((i + 1) * 2**30 + step * 2**20),
+        duty_cycle_percent=lambda step, i=idx: float((i * 7 + step) % 100),
+        ici_bytes_per_step=1e6,
+    )
+    backend = FakeBackend(chips=chips, script=script)
+    topo = detect_host_topology(
+        env={}, accelerator="v5p-64", slice_name="sim-slice",
+        host=f"sim-host-{idx:02d}", worker_id=str(idx),
+    )
+    store = SnapshotStore()
+    history = HistoryStore(capacity=256, max_series=2048, retention_s=0.0)
+    trace_store = tracer = None
+    if trace:
+        from tpu_pod_exporter.trace import Tracer, TraceStore
+
+        trace_store = TraceStore(max_traces=16)
+        tracer = Tracer(trace_store, slow_poll_s=0.0)
+    persister = None
+    if state_dir:
+        from tpu_pod_exporter.persist import StatePersister
+
+        persister = StatePersister(
+            state_dir, history=history,
+            exposition_fn=lambda s=store: s.current(),
+        )
+        persister.start()
+    collector = Collector(
+        backend, FakeAttribution(), store, topology=topo,
+        history=history, tracer=tracer, persister=persister,
+    )
+    server = MetricsServer(store, host="127.0.0.1", port=0,
+                           history=history, trace=trace_store)
+    server.start()
+    return {
+        "idx": idx,
+        "collector": collector,
+        "history": history,
+        "server": server,
+        "trace_store": trace_store,
+        "persister": persister,
+        "target": f"127.0.0.1:{server.port}",
+        "alive": True,
+    }
+
+
+class FleetSim:
+    """N simulated exporters, ticked from the caller's thread (scripted
+    scenario timelines need deterministic poll ordering, not N loops)."""
+
+    def __init__(self, n_targets: int, chips: int = 4,
+                 persist: bool = True, trace: bool = True,
+                 state_root: str | None = None) -> None:
+        self._tmp = None
+        if persist and state_root is None:
+            self._tmp = tempfile.TemporaryDirectory(prefix="fleet-sim-")
+            state_root = self._tmp.name
+        self.state_root = state_root
+        self.exporters = [
+            _build_exporter(
+                i, chips,
+                f"{state_root}/target-{i:02d}" if persist and state_root else None,
+                trace,
+            )
+            for i in range(n_targets)
+        ]
+        self.chips = chips
+
+    @property
+    def targets(self) -> tuple[str, ...]:
+        return tuple(e["target"] for e in self.exporters)
+
+    def tick(self) -> None:
+        for e in self.exporters:
+            if e["alive"]:
+                e["collector"].poll_once()
+
+    def kill(self, idx: int) -> str:
+        """Stop one exporter's HTTP server (its port starts refusing —
+        the clean-death shape; wedges are chaos.py's job)."""
+        e = self.exporters[idx]
+        if e["alive"]:
+            e["alive"] = False
+            e["server"].stop()
+        return e["target"]
+
+    def scrape_spans_recorded(self) -> int:
+        """Node-side /api/v1 serve spans recorded under REMOTE (fleet
+        query) trace contexts — proof the traceparent propagated."""
+        total = 0
+        for e in self.exporters:
+            ts = e["trace_store"]
+            if ts is not None:
+                total += len(ts.scrapes(64))
+        return total
+
+    def close(self) -> None:
+        for e in self.exporters:
+            if e["alive"]:
+                e["server"].stop()
+            if e["persister"] is not None:
+                e["persister"].close()
+            e["collector"].close()
+        if self._tmp is not None:
+            self._tmp.cleanup()
+
+
+def _get_json(url: str, timeout_s: float = 10.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — loopback demo
+        return json.loads(resp.read())
+
+
+def _percentile(xs: list[float], q: float) -> float:
+    ys = sorted(xs)
+    return ys[min(int(q * (len(ys) - 1) + 0.5), len(ys) - 1)]
+
+
+def run_demo(n_targets: int, chips: int, polls: int, interval_s: float,
+             queries: int, budget_ms: float, kill_one: bool,
+             persist: bool) -> dict:
+    """The acceptance scenario; returns a result dict with ``ok``."""
+    from tpu_pod_exporter.aggregate import SliceAggregator
+    from tpu_pod_exporter.fleet import FleetQueryPlane
+    from tpu_pod_exporter.metrics import SnapshotStore
+    from tpu_pod_exporter.persist import BreakerStateFile
+    from tpu_pod_exporter.server import MetricsServer
+    from tpu_pod_exporter.trace import Tracer, TraceStore
+
+    result: dict = {"targets": n_targets, "chips": chips, "ok": False,
+                    "tracing": True, "persistence": persist}
+    sim = FleetSim(n_targets, chips=chips, persist=persist, trace=True)
+    agg_server = None
+    fleet = None
+    agg = None
+    try:
+        for _ in range(polls):
+            sim.tick()
+            time.sleep(interval_s)
+
+        trace_store = TraceStore(max_traces=128)
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            sim.targets, store, timeout_s=1.0,
+            tracer=Tracer(trace_store, slow_poll_s=0.0, root_name="round"),
+            breaker_store=(
+                BreakerStateFile(f"{sim.state_root}/agg-breakers.json")
+                if persist and sim.state_root else None
+            ),
+        )
+        fleet = FleetQueryPlane(
+            sim.targets, timeout_s=1.0, breakers=agg.breakers,
+            tracer=Tracer(trace_store, slow_poll_s=0.0, root_name="query"),
+            generation_fn=lambda: agg.rounds,
+        )
+        agg.set_fleet(fleet)
+        agg.poll_once()
+        agg_server = MetricsServer(store, host="127.0.0.1", port=0,
+                                   fleet=fleet, trace=trace_store,
+                                   debug_vars=agg.debug_vars)
+        agg_server.start()
+        base = f"http://127.0.0.1:{agg_server.port}"
+
+        # --- full merge: one query answers for the whole fleet ----------
+        now = time.time()
+        # .3f, not .0f: rounding `end` to whole seconds can land it BEFORE
+        # the just-primed samples and fake an empty fleet.
+        doc = _get_json(
+            f"{base}/api/v1/query_range?metric=tpu_tensorcore_duty_cycle_percent"
+            f"&start={now - 120:.3f}&end={now:.3f}&step=1"
+        )
+        result["full_merge"] = {
+            "merged_series": doc["fleet"]["merged_series"],
+            "ok_targets": doc["fleet"]["ok"],
+            "partial": doc["partial"],
+            "staleness_present": all(
+                st.get("staleness_s") is not None
+                for st in doc["targets"].values()
+            ),
+        }
+        if doc["partial"] or doc["fleet"]["ok"] != n_targets:
+            result["error"] = f"expected full merge from {n_targets}: {doc['fleet']}"
+            return result
+        if doc["fleet"]["merged_series"] != n_targets * chips:
+            result["error"] = (
+                f"merged {doc['fleet']['merged_series']} series, "
+                f"expected {n_targets * chips}"
+            )
+            return result
+        if not result["full_merge"]["staleness_present"]:
+            result["error"] = "per-target staleness missing"
+            return result
+
+        # --- p99 latency budget (cache-busted: every query a fresh grid) -
+        metrics = ("tpu_tensorcore_duty_cycle_percent", "tpu_hbm_used_bytes")
+        tails: list[float] = []
+        for q in range(queries):
+            sim.tick()  # keep data moving while querying
+            now = time.time()
+            url = (
+                f"{base}/api/v1/query_range?metric={metrics[q % 2]}"
+                f"&start={now - 60 - q:.3f}&end={now:.3f}&step=1"
+            )
+            t0 = time.perf_counter()
+            doc = _get_json(url)
+            tails.append(time.perf_counter() - t0)
+            if doc["partial"]:
+                result["error"] = f"unexpected partial at query {q}: {doc['targets']}"
+                return result
+        p99 = _percentile(tails, 0.99)
+        result["query_p99_ms"] = round(p99 * 1e3, 2)
+        result["query_p50_ms"] = round(_percentile(tails, 0.5) * 1e3, 2)
+        result["budget_ms"] = budget_ms
+
+        # --- traceparent propagation: node-side serve spans joined -------
+        result["node_side_query_spans"] = sim.scrape_spans_recorded()
+        if result["node_side_query_spans"] == 0:
+            result["error"] = "no node-side /api/v1 spans recorded (traceparent lost)"
+            return result
+
+        # --- kill one target mid-query → partial, remainder merged -------
+        if kill_one:
+            victim_idx = n_targets // 2
+            killed = {}
+
+            def _kill() -> None:
+                time.sleep(0.002)  # land inside the fan-out below
+                killed["target"] = sim.kill(victim_idx)
+
+            # New aggregator round first: the result cache keys on the
+            # round generation, and the kill assertions below must observe
+            # live fan-outs, not a pre-kill cached envelope.
+            agg.poll_once()
+            killer = threading.Thread(target=_kill, name="fleet-demo-kill",
+                                      daemon=True)
+            killer.start()
+            now = time.time()
+            _get_json(
+                f"{base}/api/v1/query_range?metric=tpu_tensorcore_duty_cycle_percent"
+                f"&start={now - 120:.3f}&end={now:.3f}&step=1"
+            )  # the mid-kill query: partial OR full depending on the race
+            killer.join(timeout=5)
+            agg.poll_once()  # next round: fresh generation after the kill
+            now = time.time()
+            doc = _get_json(
+                f"{base}/api/v1/query_range?metric=tpu_tensorcore_duty_cycle_percent"
+                f"&start={now - 120:.3f}&end={now:.3f}&step=1"
+            )
+            result["after_kill"] = {
+                "killed": killed.get("target"),
+                "partial": doc["partial"],
+                "ok_targets": doc["fleet"]["ok"],
+                "merged_series": doc["fleet"]["merged_series"],
+                "victim_state": doc["targets"][killed["target"]]["state"],
+            }
+            if not doc["partial"]:
+                result["error"] = "killed target did not yield partial=true"
+                return result
+            if doc["fleet"]["ok"] != n_targets - 1:
+                result["error"] = (
+                    f"expected {n_targets - 1} ok targets after kill, "
+                    f"got {doc['fleet']['ok']}"
+                )
+                return result
+            if doc["fleet"]["merged_series"] != (n_targets - 1) * chips:
+                result["error"] = (
+                    f"expected {(n_targets - 1) * chips} merged series "
+                    f"after kill, got {doc['fleet']['merged_series']}"
+                )
+                return result
+
+        if p99 > budget_ms / 1e3:
+            result["error"] = (
+                f"fleet query p99 {p99 * 1e3:.1f}ms exceeds budget "
+                f"{budget_ms:.0f}ms"
+            )
+            return result
+        result["ok"] = True
+        return result
+    finally:
+        if agg_server is not None:
+            agg_server.stop()
+        if fleet is not None:
+            fleet.close()
+        if agg is not None:
+            agg.close()
+        sim.close()
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-loadgen-fleet",
+        description="Simulated-fleet acceptance harness for the federated "
+                    "query plane (make fleet-query-demo).",
+    )
+    p.add_argument("--targets", type=int, default=64)
+    p.add_argument("--chips", type=int, default=4, help="chips per host")
+    p.add_argument("--polls", type=int, default=10,
+                   help="history-priming polls before aggregation")
+    p.add_argument("--interval-s", type=float, default=0.02,
+                   help="pause between priming polls")
+    p.add_argument("--queries", type=int, default=40,
+                   help="latency-measurement queries (cache-busted)")
+    p.add_argument("--budget-ms", type=float, default=1500.0,
+                   help="fleet query p99 budget")
+    p.add_argument("--kill-one", action="store_true", default=True)
+    p.add_argument("--no-kill", dest="kill_one", action="store_false",
+                   help="skip the mid-run target kill")
+    p.add_argument("--no-persist", dest="persist", action="store_false",
+                   default=True, help="disable per-target persistence")
+    ns = p.parse_args(argv)
+
+    result = run_demo(
+        ns.targets, ns.chips, ns.polls, ns.interval_s,
+        ns.queries, ns.budget_ms, ns.kill_one, ns.persist,
+    )
+    print(json.dumps(result, indent=1))
+    if not result["ok"]:
+        print(f"FLEET QUERY DEMO FAILED: {result.get('error')}",
+              file=sys.stderr)
+        return 1
+    print(
+        f"fleet-query-demo OK: {ns.targets} targets, "
+        f"p99 {result['query_p99_ms']}ms (budget {ns.budget_ms:.0f}ms), "
+        f"kill→partial asserted" if ns.kill_one else "kill skipped",
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

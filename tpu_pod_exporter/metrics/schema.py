@@ -423,12 +423,32 @@ TPU_EXPORTER_HISTORY_APPEND_SECONDS = MetricSpec(
     type=GAUGE,
 )
 
+# Multi-resolution downsample tiers (history.DEFAULT_TIER_SPEC): occupancy
+# and answerable span per tier, labeled by bucket width in seconds. These
+# are how an operator audits that long-range query_range answers actually
+# have tier data behind them (the Grafana "tier occupancy" panel).
+TPU_EXPORTER_HISTORY_TIER_BUCKETS = MetricSpec(
+    name="tpu_exporter_history_tier_buckets",
+    help="Downsample buckets currently retained across all series of this tier (open accumulator buckets included).",
+    type=GAUGE,
+    label_names=("tier",),
+)
+
+TPU_EXPORTER_HISTORY_TIER_SPAN_SECONDS = MetricSpec(
+    name="tpu_exporter_history_tier_span_seconds",
+    help="Wall-clock span this downsample tier can currently answer for (newest minus oldest retained bucket) — how far back a query_range at this tier's resolution reaches.",
+    type=GAUGE,
+    label_names=("tier",),
+)
+
 HISTORY_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_HISTORY_SERIES,
     TPU_EXPORTER_HISTORY_SAMPLES,
     TPU_EXPORTER_HISTORY_MEMORY_BYTES,
     TPU_EXPORTER_HISTORY_EVICTED_SERIES_TOTAL,
     TPU_EXPORTER_HISTORY_APPEND_SECONDS,
+    TPU_EXPORTER_HISTORY_TIER_BUCKETS,
+    TPU_EXPORTER_HISTORY_TIER_SPAN_SECONDS,
 )
 
 # --- Persistence self-metrics (tpu_pod_exporter.persist) ----------------------
@@ -778,6 +798,57 @@ TPU_AGG_TARGET_SCRAPE_HIST = HistogramSpec(
     name="tpu_aggregator_target_scrape_seconds",
     help="Distribution of SUCCESSFUL per-target scrape durations since start, pooled across targets (failures/timeouts are excluded — see tpu_aggregator_target_up and _scrape_errors_total).",
     buckets=POLL_DURATION_BUCKETS,
+)
+
+# --- Fleet query plane (tpu_pod_exporter.fleet) -------------------------------
+# Served by the aggregator only while the federated /api/v1 fan-out is
+# enabled — conditional surface, like HISTORY_SPECS on the exporter, hence a
+# separate tuple from AGGREGATE_SPECS.
+
+TPU_AGG_FLEET_QUERIES_TOTAL = MetricSpec(
+    name="tpu_aggregator_fleet_queries_total",
+    help="Federated /api/v1 queries served since aggregator start, by route (series / query_range / window_stats). Cache hits included — they are served queries.",
+    type=COUNTER,
+    label_names=("route",),
+)
+
+TPU_AGG_FLEET_QUERY_PARTIAL_TOTAL = MetricSpec(
+    name="tpu_aggregator_fleet_query_partial_total",
+    help="Federated queries answered with partial=true (at least one non-quarantined target errored or missed its deadline, or a quarantined target's data is absent from the merge). The partial-result RATE is the fleet forensics health signal.",
+    type=COUNTER,
+)
+
+TPU_AGG_FLEET_QUERY_TARGET_ERRORS_TOTAL = MetricSpec(
+    name="tpu_aggregator_fleet_query_target_errors_total",
+    help="Per-target fan-out failures (connection error or per-target deadline missed) across all federated queries since start.",
+    type=COUNTER,
+    label_names=("target",),
+)
+
+TPU_AGG_FLEET_QUERY_CACHE_HITS_TOTAL = MetricSpec(
+    name="tpu_aggregator_fleet_query_cache_hits_total",
+    help="Federated queries answered from the result cache (same query, same grid, same generation — dashboard-refresh traffic costs one fan-out per generation, not one per panel).",
+    type=COUNTER,
+)
+
+TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL = MetricSpec(
+    name="tpu_aggregator_fleet_query_cache_misses_total",
+    help="Federated queries that required a live fan-out (cache miss or bypass).",
+    type=COUNTER,
+)
+
+TPU_AGG_FLEET_QUERY_HIST = HistogramSpec(
+    name="tpu_aggregator_fleet_query_seconds",
+    help="Distribution of federated /api/v1 query latencies since start (fan-out + merge; cache hits excluded). The CI fleet-query p99 budget reads this.",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
+FLEET_QUERY_SPECS: tuple[MetricSpec, ...] = (
+    TPU_AGG_FLEET_QUERIES_TOTAL,
+    TPU_AGG_FLEET_QUERY_PARTIAL_TOTAL,
+    TPU_AGG_FLEET_QUERY_TARGET_ERRORS_TOTAL,
+    TPU_AGG_FLEET_QUERY_CACHE_HITS_TOTAL,
+    TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL,
 )
 
 AGGREGATE_SPECS: tuple[MetricSpec, ...] = (

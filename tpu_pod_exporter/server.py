@@ -17,7 +17,9 @@ Additional endpoints the reference lacks:
   JSON queries against the node-local history flight recorder
   (``tpu_pod_exporter.history``); served on the metrics port because the
   slice aggregator consumes them. Absent history (``--history-retention-s
-  0``) answers 404 JSON.
+  0``) answers 404 JSON. On the aggregator the same routes are served by
+  the federated fleet query plane (``tpu_pod_exporter.fleet``) behind the
+  same 2-permit fence.
 - ``/debug/vars``, ``/debug/stacks`` and ``/debug/trace`` (poll traces as
   Chrome ``trace_event`` JSON) answer **loopback clients only** by default
   (thread stacks, config and traces are operator surface, not fleet
@@ -99,20 +101,33 @@ log = logging.getLogger("tpu_pod_exporter.server")
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
-# The 429 storm-reject response, pre-rendered to raw wire bytes once at
-# import: under a scrape storm (~1k scrapes/s) the reject path runs per
-# request, and BaseHTTPRequestHandler.send_response formats a Date header
-# and three header lines each time — measurable CPU that a reject must not
-# spend. ``Connection: close`` both caps the handler thread's lifetime and
-# tells well-behaved clients to back off the keep-alive connection.
-_REJECT_BODY = b"too many concurrent scrapes\n"
-_REJECT_RESPONSE = (
-    b"HTTP/1.1 429 Too Many Requests\r\n"
-    b"Content-Type: text/plain; charset=utf-8\r\n"
-    b"Retry-After: 1\r\n"
-    b"Content-Length: " + str(len(_REJECT_BODY)).encode("ascii") + b"\r\n"
-    b"Connection: close\r\n"
-    b"\r\n" + _REJECT_BODY
+def prerender_429(body: bytes, content_type: str) -> bytes:
+    """A 429 + Retry-After response as raw wire bytes, rendered once at
+    import: under a storm the reject path runs per request, and
+    BaseHTTPRequestHandler.send_response formats a Date header and three
+    header lines each time — measurable CPU that a reject must not spend.
+    ``Connection: close`` both caps the handler thread's lifetime and tells
+    well-behaved clients to back off the keep-alive connection. Shared by
+    the /metrics scrape guard and the /api/v1 query fence (exporter and
+    aggregator both — extracted, not duplicated)."""
+    return (
+        b"HTTP/1.1 429 Too Many Requests\r\n"
+        b"Content-Type: " + content_type.encode("ascii") + b"\r\n"
+        b"Retry-After: 1\r\n"
+        b"Content-Length: " + str(len(body)).encode("ascii") + b"\r\n"
+        b"Connection: close\r\n"
+        b"\r\n" + body
+    )
+
+
+_REJECT_RESPONSE = prerender_429(
+    b"too many concurrent scrapes\n", "text/plain; charset=utf-8"
+)
+# The /api/v1 fence's twin: still JSON (every consumer of these endpoints
+# parses JSON, including during the very storm this rejects).
+_API_REJECT_RESPONSE = prerender_429(
+    b'{"status": "error", "error": "too many concurrent api queries"}',
+    "application/json",
 )
 
 
@@ -198,6 +213,10 @@ class _Handler(BaseHTTPRequestHandler):
     debug_vars = None  # optional callable -> dict
     # Optional HistoryStore serving /api/v1/*; None = history disabled.
     history = None
+    # Optional fleet.FleetQueryPlane: when set (the aggregator), /api/v1/*
+    # routes are answered by the federated fan-out instead of a local
+    # history store, behind the same api_sem fence.
+    fleet = None
     # Optional trace.TraceStore: serves GET /debug/trace (Chrome
     # trace_event JSON) and records a node-side scrape span whenever a
     # /metrics request carries a traceparent header (the aggregator's
@@ -443,31 +462,81 @@ class _Handler(BaseHTTPRequestHandler):
     # ------------------------------------------------------- history queries
 
     def _serve_api(self, path: str, query: str) -> None:
-        """JSON query surface over the history flight recorder. Outside the
-        scrape fences (the aggregator's missed-round fallback must not
-        compete with the very scrape storm it is working around) but behind
-        its own small concurrency cap."""
+        """JSON query surface: node-local history flight recorder, or the
+        aggregator's federated fleet query plane when one is attached.
+        Outside the scrape fences (the aggregator's missed-round fallback
+        must not compete with the very scrape storm it is working around)
+        but behind its own small concurrency cap — the same 2-permit fence
+        and pre-rendered 429 + Retry-After on both exporter and aggregator."""
         sem = self.api_sem
         if sem is not None and not sem.acquire(timeout=self.api_queue_timeout_s):
-            self._serve_json(429, {
-                "status": "error",
-                "error": "too many concurrent history queries",
-            })
+            self.close_connection = True
+            self.wfile.write(_API_REJECT_RESPONSE)
             return
         try:
+            t0 = time.perf_counter()
             self._serve_api_inner(path, query)
+            tstore = self.trace
+            if tstore is not None:
+                # Same cross-tier join as /metrics: an /api/v1 request
+                # carrying a traceparent (the fleet query plane stamps one
+                # per fan-out leg) records this node's serve span under the
+                # remote query trace. Headerless queries record nothing.
+                ctx = parse_traceparent(self.headers.get("traceparent") or "")
+                if ctx is not None:
+                    dur = time.perf_counter() - t0
+                    tstore.record_scrape(
+                        ctx[0], ctx[1], time.time() - dur, dur,
+                        client=self.client_address[0],
+                    )
         finally:
             if sem is not None:
                 sem.release()
 
+    @staticmethod
+    def _parse_range_params(param) -> tuple[str, float, float, float, str]:
+        """Validated query_range params — shared by the node-local and
+        fleet routes so the 400 contract cannot drift between tiers."""
+        metric = param("metric")
+        if not metric:
+            raise ValueError("missing required parameter: metric")
+        end = float(param("end") or time.time())
+        start = float(param("start") or (end - 300.0))
+        step = float(param("step") or 0.0)
+        agg = param("agg") or "last"
+        if agg not in ("last", "min", "max", "mean"):
+            raise ValueError("agg must be one of last/min/max/mean")
+        # Finite + bounded before the store walks a grid: the grid
+        # loop is O((end-start)/step) Python iterations, and this
+        # endpoint is unauthenticated and exempt from the scrape
+        # fences — start=0&step=1 (~1.7e9 points) or end=inf must
+        # be a 400, not a pinned handler thread. Cap matches
+        # Prometheus's 11k resolution limit.
+        if not (math.isfinite(start) and math.isfinite(end)
+                and math.isfinite(step)):
+            raise ValueError("start/end/step must be finite")
+        if step < 0:
+            raise ValueError("step must be >= 0")
+        if end < start:
+            raise ValueError("end must be >= start")
+        if step > 0 and (end - start) / step > 11000:
+            raise ValueError(
+                "query resolution too high: (end - start) / step "
+                "must be <= 11000"
+            )
+        return metric, start, end, step, agg
+
+    @staticmethod
+    def _parse_window_params(param) -> tuple[str, float]:
+        metric = param("metric")
+        if not metric:
+            raise ValueError("missing required parameter: metric")
+        window = float(param("window") or 60.0)
+        if window <= 0:
+            raise ValueError("window must be > 0")
+        return metric, window
+
     def _serve_api_inner(self, path: str, query: str) -> None:
-        h = self.history
-        if h is None:
-            self._serve_json(404, {
-                "status": "error",
-                "error": "history disabled (--history-retention-s 0)",
-            })
-            return
         qs = parse_qs(query, keep_blank_values=True)
 
         def param(name: str, default: str | None = None) -> str | None:
@@ -479,36 +548,25 @@ class _Handler(BaseHTTPRequestHandler):
             for k, vs in qs.items()
             if k.startswith("match[") and k.endswith("]") and len(k) > 7
         }
+        if self.fleet is not None:
+            self._serve_fleet_api(path, param, match)
+            return
+        h = self.history
+        if h is None:
+            self._serve_json(404, {
+                "status": "error",
+                "error": "history disabled (--history-retention-s 0)",
+            })
+            return
         try:
             if path == "/api/v1/series":
                 self._serve_json(200, {"status": "ok", "data": h.series_list()})
                 return
             if path == "/api/v1/query_range":
-                metric = param("metric")
-                if not metric:
-                    raise ValueError("missing required parameter: metric")
-                end = float(param("end") or time.time())
-                start = float(param("start") or (end - 300.0))
-                step = float(param("step") or 0.0)
-                # Finite + bounded before the store walks a grid: the grid
-                # loop is O((end-start)/step) Python iterations, and this
-                # endpoint is unauthenticated and exempt from the scrape
-                # fences — start=0&step=1 (~1.7e9 points) or end=inf must
-                # be a 400, not a pinned handler thread. Cap matches
-                # Prometheus's 11k resolution limit.
-                if not (math.isfinite(start) and math.isfinite(end)
-                        and math.isfinite(step)):
-                    raise ValueError("start/end/step must be finite")
-                if step < 0:
-                    raise ValueError("step must be >= 0")
-                if end < start:
-                    raise ValueError("end must be >= start")
-                if step > 0 and (end - start) / step > 11000:
-                    raise ValueError(
-                        "query resolution too high: (end - start) / step "
-                        "must be <= 11000"
-                    )
-                result = h.query_range(metric, match, start, end, step)
+                metric, start, end, step, agg = self._parse_range_params(
+                    param)
+                result = h.query_range(metric, match, start, end, step,
+                                       agg=agg)
                 if not result:
                     self._serve_json(404, {
                         "status": "error",
@@ -522,12 +580,7 @@ class _Handler(BaseHTTPRequestHandler):
                 })
                 return
             if path == "/api/v1/window_stats":
-                metric = param("metric")
-                if not metric:
-                    raise ValueError("missing required parameter: metric")
-                window = float(param("window") or 60.0)
-                if window <= 0:
-                    raise ValueError("window must be > 0")
+                metric, window = self._parse_window_params(param)
                 result = h.window_stats(metric, match, window_s=window)
                 if not result:
                     self._serve_json(404, {
@@ -538,6 +591,32 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._serve_json(200, {"status": "ok",
                                        "data": {"result": result}})
+                return
+        except ValueError as e:
+            self._serve_json(400, {"status": "error", "error": str(e)})
+            return
+        self._serve_json(404, {"status": "error", "error": "unknown API path"})
+
+    def _serve_fleet_api(self, path: str, param, match: dict) -> None:
+        """Federated /api/v1 on the aggregator: same routes, same param
+        validation, but the answer is the fleet envelope — merged series
+        plus per-target status — and a dead target is partial=true, never
+        a non-200 round failure."""
+        fleet = self.fleet
+        try:
+            if path == "/api/v1/series":
+                self._serve_json(200, fleet.series())
+                return
+            if path == "/api/v1/query_range":
+                metric, start, end, step, agg = self._parse_range_params(
+                    param)
+                self._serve_json(200, fleet.query_range(
+                    metric, match, start, end, step, agg=agg))
+                return
+            if path == "/api/v1/window_stats":
+                metric, window = self._parse_window_params(param)
+                self._serve_json(200, fleet.window_stats(
+                    metric, match, window_s=window))
                 return
         except ValueError as e:
             self._serve_json(400, {"status": "error", "error": str(e)})
@@ -676,6 +755,7 @@ class MetricsServer:
         scrape_tarpit_s: float = 0.1,
         scrape_observer=None,
         history=None,
+        fleet=None,
         trace=None,
         debug_addr: str = "127.0.0.1",
         live_fn=None,
@@ -694,9 +774,12 @@ class MetricsServer:
                 "store": store,
                 "debug_vars": staticmethod(debug_vars) if debug_vars else None,
                 "history": history,
+                "fleet": fleet,
                 "trace": trace,
                 "api_sem": (
-                    threading.BoundedSemaphore(2) if history is not None else None
+                    threading.BoundedSemaphore(2)
+                    if history is not None or fleet is not None
+                    else None
                 ),
                 "debug_addr": debug_addr,
                 "health_max_age_s": health_max_age_s,

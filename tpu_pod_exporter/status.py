@@ -47,7 +47,28 @@ def main(argv=None) -> int:
     pre.add_argument("--json", action="store_true",
                      help="machine-readable output (one JSON object; with "
                           "--watch, one compact JSON line per interval)")
+    pre.add_argument("--fleet", default="",
+                     help="aggregator host:port — render a fleet-wide "
+                          "window_stats answer (per-host table + per-target "
+                          "partial/quarantine footer) instead of sampling "
+                          "local backends")
+    pre.add_argument("--fleet-window", type=float, default=60.0,
+                     help="trailing window for the fleet view, seconds")
     ns, rest = pre.parse_known_args(argv)
+    if ns.fleet:
+        try:
+            if ns.watch <= 0:
+                return _run_fleet(ns.fleet, ns.fleet_window, as_json=ns.json)
+            while True:
+                if not ns.json:
+                    print("\x1b[H\x1b[2J", end="")
+                rc = _run_fleet(ns.fleet, ns.fleet_window,
+                                as_json="line" if ns.json else False)
+                if rc != 0:
+                    return rc
+                time.sleep(ns.watch)
+        except KeyboardInterrupt:
+            return 0
     cfg = ExporterConfig.from_args(rest)
     topo = detect_host_topology(
         accelerator=cfg.accelerator, slice_name=cfg.slice_name,
@@ -92,6 +113,127 @@ def main(argv=None) -> int:
     finally:
         backend.close()
         attribution.close()
+
+
+# Metric set the fleet view folds per host: the guaranteed presence series
+# (chip counts), the HBM sum, and the duty mean — the "what is the slice
+# doing" triple.
+_FLEET_METRICS = (
+    "tpu_chip_info",
+    "tpu_hbm_used_bytes",
+    "tpu_tensorcore_duty_cycle_percent",
+)
+
+
+def fetch_fleet_window(addr: str, metric: str, window_s: float,
+                       timeout_s: float = 5.0) -> dict:
+    """One fleet window_stats envelope from the aggregator (always a 200
+    envelope — a metric with no samples anywhere is just an empty merge
+    inside it; connection-level failures raise)."""
+    import json as _json
+    import urllib.request
+
+    base = addr if addr.startswith(("http://", "https://")) else f"http://{addr}"
+    url = f"{base}/api/v1/window_stats?metric={metric}&window={window_s:g}"
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied address
+        doc = _json.loads(resp.read())
+    return doc
+
+
+def render_fleet(envelopes: dict[str, dict], window_s: float) -> str:
+    """Per-host table + per-target status footer from fleet envelopes."""
+    hosts: dict[str, dict] = {}
+    now = time.time()
+    for metric, env in envelopes.items():
+        for row in env.get("data", {}).get("result", []):
+            host = (row.get("labels") or {}).get("host", "?")
+            agg = hosts.setdefault(
+                host, {"chips": 0, "hbm": 0.0, "hbm_n": 0,
+                       "duty_sum": 0.0, "duty_n": 0, "newest": None})
+            s = row.get("stats") or {}
+            if metric == "tpu_chip_info":
+                agg["chips"] += 1
+            elif metric == "tpu_hbm_used_bytes":
+                if s.get("last") is not None:
+                    agg["hbm"] += s["last"]
+                    agg["hbm_n"] += 1
+            elif s.get("last") is not None:
+                agg["duty_sum"] += s["last"]
+                agg["duty_n"] += 1
+            ts = row.get("last_sample_wall_ts")
+            if isinstance(ts, (int, float)) and (
+                    agg["newest"] is None or ts > agg["newest"]):
+                agg["newest"] = ts
+    rows = []
+    for host in sorted(hosts):
+        a = hosts[host]
+        rows.append([
+            host,
+            a["chips"] or "-",
+            fmt_bytes(a["hbm"]) if a["hbm_n"] else "-",
+            f"{a['duty_sum'] / a['duty_n']:.1f}%" if a["duty_n"] else "-",
+            f"{now - a['newest']:.1f}s" if a["newest"] is not None else "-",
+        ])
+    out = []
+    if rows:
+        out.append(render_table(
+            rows, ["host", "chips", "hbm used", "duty avg", "stale"]))
+    else:
+        out.append("no fleet data in window")
+    # Footer folds target status across the envelopes (identical target
+    # sets; the worst state per target wins so a mid-render kill shows).
+    order = {"ok": 0, "no_data": 1, "quarantined": 2, "timeout": 3, "error": 4}
+    targets: dict[str, dict] = {}
+    partial = False
+    for env in envelopes.values():
+        partial = partial or bool(env.get("partial"))
+        for t, st in (env.get("targets") or {}).items():
+            prev = targets.get(t)
+            if prev is None or (
+                    order.get(st.get("state"), 9)
+                    > order.get(prev.get("state"), 9)):
+                targets[t] = st
+    n = len(targets)
+    ok = sum(1 for st in targets.values()
+             if st.get("state") in ("ok", "no_data"))
+    bad = [
+        f"{t} ({st.get('state')}"
+        + (f": {st['error']}" if st.get("error") else "")
+        + ")"
+        for t, st in sorted(targets.items())
+        if st.get("state") not in ("ok", "no_data")
+    ]
+    footer = f"targets: {ok}/{n} ok · window {window_s:g}s"
+    if partial:
+        footer += " · PARTIAL result"
+    if bad:
+        footer += "\n  degraded: " + ", ".join(bad)
+    out.append("")
+    out.append(footer)
+    return "\n".join(out)
+
+
+def _run_fleet(addr: str, window_s: float, as_json=False) -> int:
+    import json as _json
+
+    envelopes: dict[str, dict] = {}
+    try:
+        for metric in _FLEET_METRICS:
+            envelopes[metric] = fetch_fleet_window(addr, metric, window_s)
+    except Exception as e:  # noqa: BLE001 — a down aggregator is the answer
+        print(f"fleet query against {addr} failed: {e}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(_json.dumps(
+            {"aggregator": addr, "window_s": window_s,
+             "envelopes": envelopes},
+            indent=None if as_json == "line" else 1,
+        ), flush=True)
+        return 0
+    print(f"fleet view via {addr}")
+    print()
+    print(render_fleet(envelopes, window_s))
+    return 0
 
 
 def trend_cell(history, metric: str, chip_id, window_s: float,
