@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo egress-demo egress-drain-check clean
 
 test:
 	python -m pytest tests/ -q
@@ -92,6 +92,23 @@ persist-overhead:
 # runners — see .github/workflows/ci.yml).
 fleet-query-demo:
 	python -m tpu_pod_exporter.loadgen.fleet --targets 64 --budget-ms 1500
+
+# Remote-write egress acceptance (deploy/RUNBOOK.md "Egress backlog
+# playbook"): a seeded chaos receiver (hang/5xx/429/mid-body truncation)
+# wedges a live exporter's egress — breaker opens, backlog buffers to the
+# on-disk WAL — then a SIGKILL lands MID-SEND and the restarted shipper
+# resumes from the fsynced ack cursor. Asserts the zero-loss exactly-once
+# ledger (contiguous batch seqs, no duplicate batch or sample) and that
+# scrape+poll p99 with egress ON and the receiver WEDGED stay within 5%
+# of egress OFF. CI uploads the egress dir on failure.
+egress-demo:
+	python -m tpu_pod_exporter.egress --demo --egress-dir egress-demo-state
+
+# Backlog-drain budget: the send buffer a simulated 3-minute receiver
+# outage leaves behind must drain within budget once the receiver returns
+# (in-process, send-injected — measures shipper drain throughput).
+egress-drain-check:
+	python -m tpu_pod_exporter.egress --drain-check --outage-s 180 --budget-s 20
 
 native:
 	$(MAKE) -C native

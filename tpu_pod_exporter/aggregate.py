@@ -294,6 +294,7 @@ class SliceAggregator:
         tracer=None,
         breaker_store=None,  # persist.BreakerStateFile; None = no persistence
         fleet=None,  # fleet.FleetQueryPlane; publishes its self-metrics here
+        shipper=None,  # egress.RemoteWriteShipper; None = no push egress
     ) -> None:
         if not targets:
             raise ValueError("aggregator needs at least one target")
@@ -305,6 +306,12 @@ class SliceAggregator:
         # `rounds` — the result cache's generation, so cached envelopes
         # live exactly one round.
         self._fleet = fleet
+        # Remote-write egress (tpu_pod_exporter.egress): the aggregator
+        # ships its slice/workload rollups the same WAL-buffered way the
+        # exporter ships chip series — the round loop's only involvement
+        # is one non-blocking enqueue after each snapshot swap plus the
+        # self-metric emission (same discipline as persist/fleet).
+        self._shipper = shipper
         self.rounds = 0
         # Round tracing (tpu_pod_exporter.trace): one trace per round, one
         # span per target scrape / fallback / publish. The trace context
@@ -771,6 +778,11 @@ class SliceAggregator:
                 self._fleet.emit(b)
             except Exception:  # noqa: BLE001 — accounting must never fail a round
                 pass
+        if self._shipper is not None:
+            try:
+                self._shipper.emit(b)
+            except Exception:  # noqa: BLE001 — accounting must never fail a round
+                pass
         for lv, v in self._counters.items_for(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name):
             b.add(schema.TPU_AGG_SCRAPE_ERRORS_TOTAL, v, lv)
         for lv, v in self._counters.items_for(
@@ -802,7 +814,16 @@ class SliceAggregator:
             # mysteriously exceeding it by the build+swap span.
             round_dur = time.monotonic() - round_started
             b.add(schema.TPU_AGG_ROUND_DURATION_SECONDS, round_dur)
-        self._store.swap(b.build(timestamp=self._wallclock(), transfer=True))
+        snap = b.build(timestamp=self._wallclock(), transfer=True)
+        self._store.swap(snap)
+        if self._shipper is not None:
+            # AFTER the swap (the batch covers exactly what scrapers see);
+            # one non-blocking queue put — a wedged receiver can never
+            # stretch a round.
+            try:
+                self._shipper.on_snapshot(snap)
+            except Exception:  # noqa: BLE001 — egress must never fail a round
+                pass
         if round_started is not None:
             self._round_hist.observe(round_dur)
 
@@ -918,6 +939,10 @@ class SliceAggregator:
             # Federated query plane occupancy (None = fleet queries off).
             "fleet_query": (
                 self._fleet.stats() if self._fleet is not None else None
+            ),
+            # Remote-write egress occupancy (None = egress off).
+            "egress": (
+                self._shipper.stats() if self._shipper is not None else None
             ),
             # Round-trace ring occupancy (None = tracing off); the traces
             # themselves are at GET /debug/trace.
@@ -1040,6 +1065,22 @@ def main(argv: list[str] | None = None) -> int:
                    help="fleet query result cache entries, keyed by "
                         "(query, grid, round generation) — absorbs "
                         "dashboard-refresh traffic (0 disables)")
+    p.add_argument("--egress-url", default="",
+                   help="Prometheus remote-write receiver: push the slice/"
+                        "workload rollups there, WAL-buffered (empty "
+                        "disables — same contract as the exporter's "
+                        "--egress-url)")
+    p.add_argument("--egress-dir", default="aggregator-egress",
+                   help="durable send-buffer directory for --egress-url")
+    p.add_argument("--egress-interval-s", type=float, default=0.0,
+                   help="min seconds between egress batches (0 = every "
+                        "round)")
+    p.add_argument("--egress-max-backlog-mb", type=float, default=64.0)
+    p.add_argument("--egress-max-backlog-age-s", type=float, default=3600.0)
+    p.add_argument("--egress-timeout-s", type=float, default=5.0)
+    p.add_argument("--egress-breaker-failures", type=int, default=3)
+    p.add_argument("--egress-breaker-backoff-s", type=float, default=1.0)
+    p.add_argument("--egress-breaker-backoff-max-s", type=float, default=60.0)
     p.add_argument("--log-level", default="info")
     p.add_argument("--log-format", default="text", choices=("text", "json"),
                    help="json = one Cloud-Logging-shaped object per line")
@@ -1090,6 +1131,30 @@ def main(argv: list[str] | None = None) -> int:
         breaker_store = BreakerStateFile(
             os.path.join(ns.state_dir, "aggregator-breakers.json")
         )
+    shipper = None
+    if ns.egress_url:
+        from tpu_pod_exporter.egress import (
+            RemoteWriteShipper,
+            aggregator_egress_metrics,
+            build_breaker,
+        )
+
+        shipper = RemoteWriteShipper(
+            ns.egress_url,
+            ns.egress_dir,
+            metrics=aggregator_egress_metrics(),
+            interval_s=ns.egress_interval_s,
+            timeout_s=ns.egress_timeout_s,
+            max_backlog_mb=ns.egress_max_backlog_mb,
+            max_backlog_age_s=ns.egress_max_backlog_age_s,
+            breaker=build_breaker(
+                ns.egress_breaker_failures,
+                ns.egress_breaker_backoff_s,
+                ns.egress_breaker_backoff_max_s,
+            ),
+        )
+        shipper.load()
+        shipper.start()
     agg = SliceAggregator(
         targets, store, timeout_s=ns.timeout_s, fetch=fetch, recorder=recorder,
         # Late-bound closure (the loop is constructed just below; the
@@ -1106,6 +1171,7 @@ def main(argv: list[str] | None = None) -> int:
         breaker_backoff_max_s=max(ns.breaker_backoff_max_s, breaker_backoff_s),
         tracer=tracer,
         breaker_store=breaker_store,
+        shipper=shipper,
     )
     fleet = None
     if ns.fleet_query == "on":
@@ -1161,6 +1227,8 @@ def main(argv: list[str] | None = None) -> int:
     server.stop()
     if fleet is not None:
         fleet.close()
+    if shipper is not None:
+        shipper.close()
     agg.close()
     if recorder is not None:
         recorder.close()

@@ -334,6 +334,41 @@ class ExporterApp:
                 self._warm_snapshot = RestoredSnapshot(
                     restored.exposition, restored.exposition_ts
                 )
+        # Remote-write egress (tpu_pod_exporter.egress): WAL-buffered push
+        # shipping of the tracked families to --egress-url. The durable
+        # send buffer replays at construction (a backlog left by a crash
+        # resumes delivery from the fsynced ack cursor — zero loss, no
+        # acked re-send). --egress-url "" (the default) disables.
+        self.shipper = None
+        if cfg.egress_url:
+            from tpu_pod_exporter.egress import (
+                RemoteWriteShipper,
+                build_breaker,
+            )
+
+            egress_breaker = build_breaker(
+                cfg.egress_breaker_failures,
+                cfg.egress_breaker_backoff_s,
+                cfg.egress_breaker_backoff_max_s,
+            )
+            t = topo.labels()
+            self.shipper = RemoteWriteShipper(
+                cfg.egress_url,
+                cfg.egress_dir,
+                interval_s=cfg.egress_interval_s,
+                timeout_s=cfg.egress_timeout_s,
+                max_backlog_mb=cfg.egress_max_backlog_mb,
+                max_backlog_age_s=cfg.egress_max_backlog_age_s,
+                breaker=egress_breaker,
+                # Label-less self-series (tpu_exporter_up) must not collide
+                # across hosts in the shared receiving TSDB; series that
+                # already carry topology labels keep theirs.
+                extra_labels={
+                    "host": t["host"],
+                    "slice_name": t["slice_name"],
+                },
+            )
+            self.shipper.load()
         # Scrape-latency distribution: handler threads observe, the
         # collector emits it into each snapshot (one poll behind, which is
         # fine for a cumulative histogram).
@@ -356,6 +391,7 @@ class ExporterApp:
             supervisors=self.supervisors,
             tracer=self.tracer,
             persister=self.persister,
+            shipper=self.shipper,
             client_write_timeouts_fn=lambda: self.server.write_timeouts["total"],
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
@@ -411,8 +447,11 @@ class ExporterApp:
 
     def _ready_detail(self) -> dict:
         """Degraded-source detail for the /readyz JSON body: any source
-        whose breaker has (re-)opened across several probes. Detail only —
-        the HTTP status stays governed by first-poll completion."""
+        whose breaker has (re-)opened across several probes, plus the
+        egress shipper's receiver state once it is degraded the same way.
+        Detail only — the HTTP status stays governed by first-poll
+        completion (a down RECEIVER must never pull the exporter out of
+        rotation; its scrapes are exactly the fallback)."""
         degraded = [
             {
                 "source": source,
@@ -425,7 +464,15 @@ class ExporterApp:
             for source, sup in self.supervisors.items()
             if (st := sup.stats())["degraded"]
         ]
-        return {"degraded_sources": degraded} if degraded else {}
+        out: dict = {"degraded_sources": degraded} if degraded else {}
+        if self.shipper is not None:
+            try:
+                detail = self.shipper.ready_detail()
+                if detail["degraded"] or detail["backlog_batches"]:
+                    out["egress"] = detail
+            except Exception:  # noqa: BLE001 — detail must not break probes
+                pass
+        return out
 
     def _debug_vars(self) -> dict:
         """Introspection payload for /debug/vars (SURVEY.md §5: per-phase
@@ -483,6 +530,13 @@ class ExporterApp:
                 "dir": state_dir_summary(self.cfg.state_dir),
                 "warm": self._warm_state() is not None,
             }
+        if self.shipper is not None:
+            from tpu_pod_exporter.egress import egress_dir_summary
+
+            out["egress"] = {
+                **self.shipper.stats(),
+                "dir": egress_dir_summary(self.cfg.egress_dir),
+            }
         out["client_write_timeouts"] = self.server.write_timeouts["total"]
         if self.trace is not None:
             out["trace"] = self.trace.stats()
@@ -504,6 +558,10 @@ class ExporterApp:
     def start(self) -> None:
         if self.persister is not None:
             self.persister.start()
+        if self.shipper is not None:
+            # Before the first poll: a restart with a backlog starts
+            # draining immediately, even while the first live poll runs.
+            self.shipper.start()
         if self._warm_snapshot is not None:
             # Warm start: serve the restored exposition IMMEDIATELY and let
             # the first live poll run on the loop thread — blocking serving
@@ -556,6 +614,11 @@ class ExporterApp:
             # the exposition being served), so a rolling update warm-starts
             # with zero staleness. After loop.stop() no poll can enqueue.
             self.persister.close()
+        if self.shipper is not None:
+            # Undelivered batches stay durably buffered; the restarted
+            # process resumes them from the ack cursor (no drain wait — a
+            # down receiver must not stall the SIGTERM grace period).
+            self.shipper.close()
         if self.tracer is not None:
             self.tracer.close()
 

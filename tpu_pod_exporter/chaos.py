@@ -19,8 +19,16 @@ Spec grammar (``--chaos-spec``, test-only flag)::
 
     spec  := rule ("," rule)*
     rule  := kind ":" source (":" token)*
-    kind  := hang | err | slow | garbage | kill
-    source:= device | attribution | procscan
+    kind  := hang | err | slow | garbage | kill | reject | truncate
+    source:= device | attribution | procscan | recv
+
+The ``recv`` source is the **remote-write receiver** (:class:`ChaosReceiver`
+— an in-process HTTP receiver the egress shipper posts batches at, used by
+``make egress-demo`` and ``tests/test_egress.py``) rather than a wrapped
+poll source: ``hang``/``slow`` park the request, ``err`` answers 500,
+``reject`` answers 429 (backpressure), and ``truncate`` reads part of the
+request body then drops the connection mid-transfer. ``reject``/``truncate``
+are receiver-only; ``garbage``/``kill`` are source-only.
 
 Tokens after the source are order-free: a bare float in [0, 1] is the
 per-call probability (default 1.0), a duration with a unit ("500ms",
@@ -47,6 +55,7 @@ from __future__ import annotations
 import logging
 import random
 import re
+import threading
 import time
 from dataclasses import dataclass, field
 
@@ -54,8 +63,13 @@ from tpu_pod_exporter import trace as trace_mod
 
 log = logging.getLogger("tpu_pod_exporter.chaos")
 
-KINDS = ("hang", "err", "slow", "garbage", "kill")
-SOURCES = ("device", "attribution", "procscan")
+KINDS = ("hang", "err", "slow", "garbage", "kill", "reject", "truncate")
+SOURCES = ("device", "attribution", "procscan", "recv")
+
+# The remote-write receiver target (``recv``) injects wire-level faults
+# the wrapped in-process sources have no analog for — and vice versa.
+RECEIVER_ONLY_KINDS = ("reject", "truncate")
+RECEIVER_INVALID_KINDS = ("garbage", "kill")
 
 DEFAULT_HANG_S = 3600.0   # "forever" at poll-loop scale; the deadline fences it
 DEFAULT_SLOW_S = 0.25
@@ -105,6 +119,15 @@ def parse_chaos_spec(spec: str) -> list[ChaosRule]:
         if source not in SOURCES:
             raise ValueError(f"chaos rule {raw!r}: unknown source {source!r} "
                              f"(want one of {'/'.join(SOURCES)})")
+        if kind in RECEIVER_ONLY_KINDS and source != "recv":
+            raise ValueError(f"chaos rule {raw!r}: kind {kind!r} is only "
+                             f"valid for the recv (remote-write receiver) "
+                             f"source")
+        if source == "recv" and kind in RECEIVER_INVALID_KINDS:
+            raise ValueError(f"chaos rule {raw!r}: kind {kind!r} is not "
+                             f"valid for the recv source (the receiver "
+                             f"answers requests; it has no payload or "
+                             f"process to corrupt)")
         rule = ChaosRule(kind=kind, source=source)
         for tok in parts[2:]:
             tok = tok.strip().lower()
@@ -337,6 +360,249 @@ def apply_chaos(spec: str, seed: int, backend, attribution, scanner):
             scanner, "procscan", by_source["procscan"], seed
         )
     return backend, attribution, scanner, wrappers
+
+
+# --- Chaos remote-write receiver ---------------------------------------------
+
+
+class ChaosReceiver:
+    """In-process Prometheus remote-write receiver with a seeded fault
+    schedule — the wire-side twin of :class:`ChaosWrapper`, proving the
+    egress breaker + WAL story end to end (``make egress-demo``).
+
+    Applies ``recv``-source rules per request index with the same
+    one-rng-draw-per-rule-per-request determinism as the wrapper: ``hang``
+    parks the request for its duration then answers 503 (the client has
+    long since timed out — answering 200 after the client gave up would
+    poison the exactly-once ledger), ``err`` → 500, ``reject`` → 429,
+    ``slow`` sleeps then accepts, ``truncate`` reads part of the body and
+    drops the connection mid-transfer.
+
+    Accepted batches are decoded (vendored snappy + protobuf decoders from
+    ``tpu_pod_exporter.egress``) into a ledger: batch seqs (from the
+    shipper's ``X-Tpe-Egress-Seq`` header), per-(series, timestamp) sample
+    identity, and duplicate counts — the demo's zero-loss / no-acked-
+    re-send assertions read straight off it. A batch is recorded only
+    AFTER its 200 response was written successfully: if the client vanished
+    mid-response the write raises and the batch stays unaccounted, exactly
+    as the sender (which saw a failure and will re-send) believes.
+
+    ``poison_seqs`` (test knob): respond 400 to those batch seqs — the
+    shipper must count-and-skip them without wedging the queue.
+    """
+
+    def __init__(self, rules: list[ChaosRule], seed: int = 0,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        import http.server
+
+        self.rules = [r for r in rules if r.source == "recv"]
+        self._rng = random.Random(f"{seed}:recv")
+        self.calls = 0
+        self.injected: list[tuple[int, str]] = []
+        self.poison_seqs: set[int] = set()
+        self._lock = threading.Lock()
+        self._accepted_seqs: list[int] = []
+        self._accepted_set: set[int] = set()
+        self._samples: set[tuple] = set()
+        self._accepted_samples = 0
+        self._duplicate_seqs: list[int] = []
+        self._duplicate_samples = 0
+        self._requests = 0
+        # hold_next() choreography: park one request mid-handling and tell
+        # the caller it is in flight (the demo SIGKILLs the sender there).
+        self._hold_pending: threading.Event | None = None
+        self._hold_release = threading.Event()
+        self._hold_s = 0.0
+
+        receiver = self
+
+        class _RecvHandler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_POST(self) -> None:  # noqa: N802 — stdlib API
+                receiver._handle(self)
+
+            def log_message(self, fmt: str, *args) -> None:
+                log.debug("chaos-recv: " + fmt, *args)
+
+        class _RecvServer(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+
+            def handle_error(self, request, client_address) -> None:
+                # A SIGKILLed sender leaves a broken pipe mid-response —
+                # expected chaos, not a server fault worth a stack trace.
+                log.debug("chaos-recv: handler error from %s",
+                          client_address)
+
+        self._httpd = _RecvServer((host, port), _RecvHandler)
+        self._thread: threading.Thread | None = None
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}/api/v1/write"
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="tpu-chaos-recv", daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._hold_release.set()
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    # ------------------------------------------------------------- schedule
+
+    def _draw(self, idx: int) -> ChaosRule | None:
+        """Same determinism contract as ChaosWrapper._invoke: every rule
+        consumes exactly one draw per request regardless of what earlier
+        rules did; first hitting, armed, non-exhausted rule wins."""
+        triggered: ChaosRule | None = None
+        for rule in self.rules:
+            draw = self._rng.random()
+            if (
+                triggered is None
+                and draw < rule.prob
+                and idx >= rule.min_index
+                and (rule.max_count is None or rule.fired < rule.max_count)
+            ):
+                triggered = rule
+        if triggered is not None:
+            triggered.fired += 1
+            self.injected.append((idx, triggered.kind))
+        return triggered
+
+    # ------------------------------------------------------------- handling
+
+    def hold_next(self, hold_s: float = 10.0) -> threading.Event:
+        """Arm a one-shot hold: the NEXT request parks un-answered for up
+        to ``hold_s`` (or until release_hold()). Returns an Event set the
+        moment that request is in flight — the demo's SIGKILL-mid-send
+        trigger."""
+        ev = threading.Event()
+        with self._lock:
+            self._hold_pending = ev
+            self._hold_s = hold_s
+            self._hold_release.clear()
+        return ev
+
+    def release_hold(self) -> None:
+        self._hold_release.set()
+
+    def _handle(self, h) -> None:
+        with self._lock:
+            idx = self.calls
+            self.calls += 1
+            rule = self._draw(idx)
+            hold = self._hold_pending
+            if hold is not None:
+                self._hold_pending = None
+        if hold is not None:
+            hold.set()
+            self._hold_release.wait(self._hold_s)
+            self._respond(h, 503, b"held\n")
+            return
+        length = int(h.headers.get("Content-Length") or 0)
+        if rule is not None and rule.kind == "truncate":
+            # Read part of the body, then drop the connection mid-transfer
+            # — the client sees a reset, nothing was received.
+            h.rfile.read(min(length, max(length // 2, 1)))
+            try:
+                h.connection.close()
+            except OSError:
+                pass
+            return
+        body = h.rfile.read(length) if length else b""
+        if rule is not None:
+            if rule.kind in ("hang", "slow"):
+                time.sleep(rule.effective_duration_s)
+                if rule.kind == "hang":
+                    self._respond(h, 503, b"wedged\n")
+                    return
+            elif rule.kind == "err":
+                self._respond(h, 500, b"injected error\n")
+                return
+            elif rule.kind == "reject":
+                self._respond(h, 429, b"backpressure\n")
+                return
+        self._accept(h, body)
+
+    def _accept(self, h, body: bytes) -> None:
+        from tpu_pod_exporter.egress import (
+            SEQ_HEADER,
+            parse_write_request,
+            snappy_decompress,
+        )
+
+        try:
+            series = parse_write_request(snappy_decompress(body))
+        except ValueError as e:
+            self._respond(h, 400, f"bad batch: {e}\n".encode())
+            return
+        try:
+            seq = int(h.headers.get(SEQ_HEADER) or 0)
+        except ValueError:
+            seq = 0
+        if seq in self.poison_seqs:
+            self._respond(h, 400, b"poisoned\n")
+            return
+        # Respond FIRST; ledger only what the client could have seen acked.
+        try:
+            self._respond(h, 200, b"ok\n")
+        except OSError:
+            return  # client gone mid-response: it will re-send; no record
+        with self._lock:
+            self._requests += 1
+            if seq:
+                if seq in self._accepted_set:
+                    self._duplicate_seqs.append(seq)
+                else:
+                    self._accepted_set.add(seq)
+                    self._accepted_seqs.append(seq)
+            for labels, samples in series:
+                ident = tuple(sorted(labels.items()))
+                for _value, ts_ms in samples:
+                    key = (ident, ts_ms)
+                    if key in self._samples:
+                        self._duplicate_samples += 1
+                    else:
+                        self._samples.add(key)
+                        self._accepted_samples += 1
+
+    @staticmethod
+    def _respond(h, code: int, body: bytes) -> None:
+        h.send_response(code)
+        h.send_header("Content-Type", "text/plain")
+        h.send_header("Content-Length", str(len(body)))
+        h.end_headers()
+        h.wfile.write(body)
+        h.wfile.flush()
+
+    # ----------------------------------------------------------------- stats
+
+    def accepted_batches(self) -> int:
+        with self._lock:
+            return len(self._accepted_seqs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "requests": self._requests,
+                "calls": self.calls,
+                "injected": list(self.injected),
+                "accepted_seqs": list(self._accepted_seqs),
+                "accepted_samples": self._accepted_samples,
+                "duplicate_seqs": list(self._duplicate_seqs),
+                "duplicate_samples": self._duplicate_samples,
+            }
 
 
 # --- Demo: a wedge, observed end to end --------------------------------------

@@ -53,6 +53,7 @@ from tpu_pod_exporter.utils import RateLimitedLogger
 from tpu_pod_exporter.version import __version__
 
 if TYPE_CHECKING:  # import-cycle-free typing only
+    from tpu_pod_exporter.egress import RemoteWriteShipper
     from tpu_pod_exporter.history import HistoryStore
     from tpu_pod_exporter.metrics.registry import Snapshot
     from tpu_pod_exporter.persist import StatePersister
@@ -111,6 +112,8 @@ class Collector:
         tracer: "Tracer | None" = None,
         # persist.StatePersister; None = no persistence
         persister: "StatePersister | None" = None,
+        # egress.RemoteWriteShipper; None = no push egress
+        shipper: "RemoteWriteShipper | None" = None,
         # () -> int, from the HTTP server
         client_write_timeouts_fn: Callable[[], int] | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -164,6 +167,12 @@ class Collector:
         # I/O runs on the persister's writer thread.
         self._persister = persister
         self._persist_s = 0.0
+        # Remote-write egress: fed once per poll AFTER persistence, on its
+        # own phase — the same excluded-from-publish/total accounting. The
+        # poll-side cost is one non-blocking queue put; batch building and
+        # every byte of network/disk I/O run on the shipper's own threads.
+        self._shipper = shipper
+        self._egress_s = 0.0
         self._client_write_timeouts_fn = client_write_timeouts_fn
         # Poll-phase faults repeat every interval (1 s) while a source is
         # down; rate-limit per fault key so logs show the fault, not 86k
@@ -437,6 +446,28 @@ class Collector:
             if tr is not None:
                 tr.end(persist_status, queued=queued)
             self._phase_hist.observe(self._persist_s, ("persist",))
+        # Egress LAST, on its own phase: the snapshot is swapped, recorded,
+        # and persisted, so the batch the shipper's writer extracts covers
+        # exactly what every other consumer saw — and like persist, the
+        # enqueue must never read as publish/total poll latency (the
+        # phase-exclusion is test-asserted in tests/test_egress.py).
+        if self._shipper is not None:
+            if tr is not None:
+                tr.begin("egress")
+            te0 = self._clock()
+            equeued = 0
+            egress_status = "ok"
+            try:
+                equeued = self._shipper.on_snapshot(snap)
+            except Exception as e:  # noqa: BLE001 — egress must not fail a poll
+                egress_status = "err"
+                self._rlog.error(
+                    "egress", "egress enqueue failed: %s", e, exc_info=True,
+                )
+            self._egress_s = self._clock() - te0
+            if tr is not None:
+                tr.end(egress_status, queued=equeued)
+            self._phase_hist.observe(self._egress_s, ("egress",))
         if tr is not None:
             tracer.finish(tr, status="ok" if stats.ok else "err",
                           errors=len(errors), skips=len(skips))
@@ -927,6 +958,15 @@ class Collector:
                         schema.TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS,
                         max(self._wallclock() - ps["last_snapshot_wall"], 0.0),
                     )
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
+
+        if self._shipper is not None:
+            # Conditional egress surface (EGRESS_SPECS), same rule as the
+            # history/persist stats: declared + sampled only when a shipper
+            # is attached, read one poll behind like every other self-stat.
+            try:
+                self._shipper.emit(b)
             except Exception:  # noqa: BLE001 — accounting must never fail a poll
                 pass
 

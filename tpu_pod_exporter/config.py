@@ -130,6 +130,36 @@ class ExporterConfig:
     # strongest guarantee, affordable on local SSD (make
     # persist-fsync-check measures it).
     state_fsync_interval_s: float = 5.0
+    # Remote-write egress (tpu_pod_exporter.egress): push the tracked
+    # metric families to a Prometheus remote-write receiver, batched per
+    # snapshot swap (delta-aware), snappy-compressed, buffered through a
+    # crash-safe on-disk WAL so a receiver outage or a restart drops
+    # nothing. Empty (the default) disables the whole layer.
+    egress_url: str = ""
+    # Durable send-buffer directory (CRC-framed segments + fsynced ack
+    # cursor). Required when --egress-url is set; in the DaemonSet point
+    # it at a hostPath so the backlog survives pod replacement.
+    egress_dir: str = "/var/lib/tpu-pod-exporter/egress"
+    # Minimum seconds between egress batches: snapshots arriving faster
+    # are skipped (not buffered). 0 ships every poll.
+    egress_interval_s: float = 1.0
+    # Backlog caps while the receiver is unreachable: oldest batches are
+    # dropped (counted in tpu_exporter_egress_dropped_total{reason=
+    # "backlog"}) past either bound — bounded loss by explicit policy,
+    # never unbounded disk growth.
+    egress_max_backlog_mb: float = 64.0
+    egress_max_backlog_age_s: float = 3600.0
+    # Per-send HTTP deadline: a hanging receiver costs the SENDER thread
+    # at most this long per attempt; the poll path never waits on egress.
+    egress_timeout_s: float = 5.0
+    # Receiver circuit breaker (same contract as the source breakers):
+    # this many consecutive send failures (timeout/connection/5xx/429)
+    # open it; while open, batches buffer to disk and a half-open probe
+    # sends a single batch after expo backoff + jitter. 0 disables the
+    # breaker (every batch attempted immediately).
+    egress_breaker_failures: int = 3
+    egress_breaker_backoff_s: float = 1.0
+    egress_breaker_backoff_max_s: float = 60.0
     # Slow-client write defense: per-connection socket SEND timeout. A
     # scraper that stalls mid-body (stuck TCP peer, frozen pipe) gets its
     # connection dropped after this many seconds instead of pinning a

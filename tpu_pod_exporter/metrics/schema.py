@@ -509,6 +509,80 @@ PERSIST_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS,
 )
 
+# --- Remote-write egress (tpu_pod_exporter.egress) ---------------------------
+# Emitted only when egress is enabled (--egress-url set) — the same
+# conditional-surface rule as PERSIST_SPECS. Both the exporter and the
+# aggregator attach a RemoteWriteShipper, so both expositions may carry
+# these. The send buffer's health must be auditable from the exposition:
+# a receiver outage shows as breaker_state=1 + growing backlog, and a
+# silently-dropping backlog cap is exactly the loss the alert rules watch.
+
+TPU_EXPORTER_EGRESS_SENT_BATCHES_TOTAL = MetricSpec(
+    name="tpu_exporter_egress_sent_batches_total",
+    help="Remote-write batches acknowledged by the receiver (2xx) since start. Each acked batch is durably marked in the send buffer's cursor, so a restart never re-sends it.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_EGRESS_SENT_SAMPLES_TOTAL = MetricSpec(
+    name="tpu_exporter_egress_sent_samples_total",
+    help="Samples delivered inside acknowledged remote-write batches since start.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_EGRESS_FAILED_SENDS_TOTAL = MetricSpec(
+    name="tpu_exporter_egress_failed_sends_total",
+    help="Remote-write send attempts that failed (timeout, connection error, 5xx, or 429 backpressure) since start. Failed batches stay in the durable send buffer and are retried breaker-gated; compare with dropped to tell 'retrying' from 'losing'.",
+    type=COUNTER,
+)
+
+TPU_EXPORTER_EGRESS_DROPPED_TOTAL = MetricSpec(
+    name="tpu_exporter_egress_dropped_total",
+    help="Batches removed from the send buffer WITHOUT delivery, by reason: 'backlog' (bytes/age cap while the receiver was down), 'poison' (non-429 4xx — the receiver rejects the batch body, retrying would wedge the queue), 'queue' (poll-side handoff full: the egress writer stalled), 'corrupt' (torn/scrambled buffer records truncated at boot).",
+    type=COUNTER,
+    label_names=("reason",),
+)
+
+TPU_EXPORTER_EGRESS_BACKLOG_BATCHES = MetricSpec(
+    name="tpu_exporter_egress_backlog_batches",
+    help="Batches currently sitting in the durable send buffer awaiting acknowledgement (0 when the shipper is keeping up).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_EGRESS_BACKLOG_BYTES = MetricSpec(
+    name="tpu_exporter_egress_backlog_bytes",
+    help="On-disk bytes of unacknowledged batches in the send buffer under --egress-dir (bounded by --egress-max-backlog-mb).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_EGRESS_BACKLOG_AGE_SECONDS = MetricSpec(
+    name="tpu_exporter_egress_backlog_age_seconds",
+    help="Age of the OLDEST unacknowledged batch in the send buffer (0 when empty) — how far behind the receiver the shipped telemetry is; bounded by --egress-max-backlog-age-s.",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_EGRESS_BREAKER_STATE = MetricSpec(
+    name="tpu_exporter_egress_breaker_state",
+    help="Remote-write receiver circuit breaker: 0=closed (healthy), 1=open (receiver quarantined, backoff running, batches buffering to disk), 2=half_open (single probe batch in flight).",
+    type=GAUGE,
+)
+
+TPU_EXPORTER_EGRESS_SEND_SECONDS_HIST = HistogramSpec(
+    name="tpu_exporter_egress_send_seconds",
+    help="Distribution of remote-write send round-trips since start (successful and failed attempts; breaker-skipped sends are not attempts).",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
+EGRESS_SPECS: tuple[MetricSpec, ...] = (
+    TPU_EXPORTER_EGRESS_SENT_BATCHES_TOTAL,
+    TPU_EXPORTER_EGRESS_SENT_SAMPLES_TOTAL,
+    TPU_EXPORTER_EGRESS_FAILED_SENDS_TOTAL,
+    TPU_EXPORTER_EGRESS_DROPPED_TOTAL,
+    TPU_EXPORTER_EGRESS_BACKLOG_BATCHES,
+    TPU_EXPORTER_EGRESS_BACKLOG_BYTES,
+    TPU_EXPORTER_EGRESS_BACKLOG_AGE_SECONDS,
+    TPU_EXPORTER_EGRESS_BREAKER_STATE,
+)
+
 # --- Legacy migration aliases (off by default; --legacy-metrics) ------------
 # The reference's exact metric names (main.go:24,31) so its dashboards work
 # unchanged during migration. Semantic shift, documented in the help text:
@@ -849,6 +923,32 @@ FLEET_QUERY_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_FLEET_QUERY_TARGET_ERRORS_TOTAL,
     TPU_AGG_FLEET_QUERY_CACHE_HITS_TOTAL,
     TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL,
+)
+
+# The rollup surface the aggregator's remote-write egress ships
+# (tpu_pod_exporter.egress): the slice/multislice/workload rollups plus
+# per-target up — the "what is the fleet doing" set a central TSDB wants,
+# not the aggregator's own plumbing counters.
+AGGREGATE_EGRESS_SPECS: tuple[MetricSpec, ...] = (
+    TPU_SLICE_HOSTS_REPORTING,
+    TPU_SLICE_CHIP_COUNT,
+    TPU_SLICE_HBM_USED_BYTES,
+    TPU_SLICE_HBM_TOTAL_BYTES,
+    TPU_SLICE_HBM_USED_PERCENT,
+    TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
+    TPU_SLICE_ICI_BYTES_PER_SECOND,
+    TPU_SLICE_DCN_BYTES_PER_SECOND,
+    TPU_MULTISLICE_SLICES_REPORTING,
+    TPU_MULTISLICE_EXPECTED_SLICES,
+    TPU_MULTISLICE_HOSTS_REPORTING,
+    TPU_MULTISLICE_CHIP_COUNT,
+    TPU_MULTISLICE_HBM_USED_BYTES,
+    TPU_MULTISLICE_ICI_BYTES_PER_SECOND,
+    TPU_MULTISLICE_DCN_BYTES_PER_SECOND,
+    TPU_WORKLOAD_CHIP_COUNT,
+    TPU_WORKLOAD_HBM_USED_BYTES,
+    TPU_WORKLOAD_HOSTS,
+    TPU_AGG_TARGET_UP,
 )
 
 AGGREGATE_SPECS: tuple[MetricSpec, ...] = (

@@ -61,6 +61,7 @@ import threading
 import time
 import zlib
 from array import array
+from collections import deque
 from dataclasses import dataclass, field
 from typing import IO, TYPE_CHECKING, Any, Callable, Mapping
 
@@ -106,43 +107,58 @@ def append_record(f: IO[bytes], payload: bytes) -> int:
     return _HDR.size + len(payload)
 
 
-def read_record_file(path: str) -> tuple[list[bytes], int, str | None]:
-    """Read a record file; returns (payloads, valid_bytes, error).
-
-    ``payloads`` is the longest clean prefix of records; ``valid_bytes`` is
-    the file offset just past it (the truncate point for reopening a WAL
-    with a torn tail); ``error`` describes why reading stopped early, or
-    None for a clean end-of-file."""
+def _walk_records(
+    path: str, collect_payloads: bool
+) -> tuple[list[tuple[int, int]], list[bytes], int, str | None]:
+    """The ONE CRC-framed record walker (read_record_file and the
+    WalBuffer segment scan are both views of it — the framing rules must
+    never exist twice). Returns (offsets, payloads, valid_bytes, error):
+    ``offsets`` is [(payload_offset, payload_len), ...] for the longest
+    clean prefix, ``payloads`` the corresponding bytes when requested,
+    ``valid_bytes`` the file offset just past the prefix (the truncate
+    point for a torn tail), ``error`` why reading stopped early (None for
+    a clean end-of-file)."""
+    offsets: list[tuple[int, int]] = []
     payloads: list[bytes] = []
     try:
         f = open(path, "rb")
     except FileNotFoundError:
-        return payloads, 0, None
+        return offsets, payloads, 0, None
     except OSError as e:
-        return payloads, 0, f"unreadable: {e}"
+        return offsets, payloads, 0, f"unreadable: {e}"
     with f:
         head = f.read(len(MAGIC))
         if len(head) < len(MAGIC):
-            return payloads, 0, None if not head else "short magic"
+            return offsets, payloads, 0, None if not head else "short magic"
         if head != MAGIC:
-            return payloads, 0, f"bad magic {head!r}"
+            return offsets, payloads, 0, f"bad magic {head!r}"
         valid = len(MAGIC)
         while True:
             hdr = f.read(_HDR.size)
             if not hdr:
-                return payloads, valid, None
+                return offsets, payloads, valid, None
             if len(hdr) < _HDR.size:
-                return payloads, valid, "torn record header"
+                return offsets, payloads, valid, "torn record header"
             length, crc = _HDR.unpack(hdr)
             if length > MAX_RECORD_BYTES:
-                return payloads, valid, f"implausible record length {length}"
+                return (offsets, payloads, valid,
+                        f"implausible record length {length}")
             payload = f.read(length)
             if len(payload) < length:
-                return payloads, valid, "torn record payload"
+                return offsets, payloads, valid, "torn record payload"
             if zlib.crc32(payload) != crc:
-                return payloads, valid, "record CRC mismatch"
-            payloads.append(payload)
+                return offsets, payloads, valid, "record CRC mismatch"
+            offsets.append((valid + _HDR.size, length))
+            if collect_payloads:
+                payloads.append(payload)
             valid += _HDR.size + length
+
+
+def read_record_file(path: str) -> tuple[list[bytes], int, str | None]:
+    """Read a record file; returns (payloads, valid_bytes, error) — the
+    longest clean prefix of records (see :func:`_walk_records`)."""
+    _offsets, payloads, valid, err = _walk_records(path, True)
+    return payloads, valid, err
 
 
 def _fsync_dir(path: str) -> None:
@@ -856,6 +872,344 @@ class StatePersister:
         with self._stats_lock:
             self._stats["snapshots"] += 1
             self._stats["last_snapshot_wall"] = self._wallclock()
+
+
+# ------------------------------------------------------ durable send buffer
+
+
+def _scan_segment(path: str) -> tuple[list[tuple[int, int]], int, str | None]:
+    """Scan one CRC-framed segment file; returns (records, valid_bytes,
+    error). ``records`` is [(payload_offset, payload_len), ...] for the
+    longest clean prefix — the offset/length pairs a consumer needs to
+    re-read payloads lazily instead of materializing the whole backlog
+    (the offsets-only view of :func:`_walk_records`)."""
+    offsets, _payloads, valid, err = _walk_records(path, False)
+    return offsets, valid, err
+
+
+class WalBuffer:
+    """Durable, segmented FIFO of opaque payload records — the reusable
+    generalization of :class:`StatePersister`'s WAL machinery (same CRC32
+    framing, rotation, and torn-write-tolerant replay) packaged as a queue
+    with a persisted consumer cursor. Built for the remote-write egress
+    send buffer (``tpu_pod_exporter.egress``); generic over payload bytes.
+
+    Layout under ``dir``: ``seg-%08d.wal`` segment files (each MAGIC +
+    CRC-framed records) plus ``cursor.json`` — ``{"seg": n, "rec": k}``
+    means the first ``k`` records of segment ``n`` (and every earlier
+    segment) are acknowledged and must NEVER be re-delivered, even across
+    a crash: the cursor is written atomically (write-temp → fsync →
+    rename) on every ack. Fully-acked segments are unlinked.
+
+    Boot replay (:meth:`open`) tolerates torn writes: the newest segment
+    is truncated at its last clean record (appends continue from there);
+    an older segment corrupted mid-file keeps its clean prefix and the
+    segments after it — corruption loses the torn records, never the
+    buffer. A missing cursor segment means it was fully acked.
+
+    Threading: one appender thread plus one consumer thread. The internal
+    lock guards ONLY in-memory index state (entry deque, counters); all
+    file I/O happens outside it, so neither thread can ever park the other
+    inside a filesystem call — and the poll thread never touches this
+    class at all.
+    """
+
+    SEGMENT_FMT = "seg-%08d.wal"
+    CURSOR_NAME = "cursor.json"
+
+    def __init__(self, path: str, segment_max_bytes: int = 4 << 20,
+                 fsync: bool = True) -> None:
+        self.dir = path
+        self.segment_max_bytes = segment_max_bytes
+        self.fsync_each = fsync
+        self._lock = threading.Lock()
+        # Pending (unacked) records, oldest first: (seg_no, rec_idx,
+        # payload_offset, payload_len).
+        self._entries: "deque[tuple[int, int, int, int]]" = deque()
+        self._pending_bytes = 0
+        self._acked_seg = -1   # cursor: segments <= this with...
+        self._acked_rec = 0    # ...first _acked_rec records of _acked_seg acked
+        # Lowest segment number that may still have a file on disk — the
+        # unlink sweep's start. Advanced only past segments actually
+        # removed (a failed unlink is retried on the next advance).
+        self._min_seg = 0
+        self._active_seg = 0
+        self._active_count = 0   # records written to the active segment
+        self._active_bytes = 0
+        self._f: IO[bytes] | None = None
+        self.corrupt_segments = 0
+        self.errors: list[str] = []
+
+    def _seg_path(self, seg: int) -> str:
+        return os.path.join(self.dir, self.SEGMENT_FMT % seg)
+
+    @property
+    def _cursor_path(self) -> str:
+        return os.path.join(self.dir, self.CURSOR_NAME)
+
+    # ------------------------------------------------------------------ boot
+
+    def open(self) -> dict:
+        """Create the dir, load the cursor, replay segments into the
+        pending index. Never raises on corruption (clean-prefix semantics);
+        raises OSError only if the directory itself cannot be created."""
+        os.makedirs(self.dir, exist_ok=True)
+        cur_seg, cur_rec = -1, 0
+        try:
+            with open(self._cursor_path, encoding="utf-8") as f:
+                doc = json.load(f)
+            cur_seg = int(doc.get("seg", -1))
+            cur_rec = max(int(doc.get("rec", 0)), 0)
+        except FileNotFoundError:
+            pass
+        except Exception as e:  # noqa: BLE001 — a bad cursor restarts delivery, never boot
+            self.errors.append(f"cursor unreadable ({e}); delivering from "
+                               f"the oldest retained record")
+        seg_nos = []
+        try:
+            for name in os.listdir(self.dir):
+                if name.startswith("seg-") and name.endswith(".wal"):
+                    try:
+                        seg_nos.append(int(name[4:-4]))
+                    except ValueError:
+                        continue
+        except OSError as e:
+            self.errors.append(f"segment listing failed: {e}")
+        seg_nos.sort()
+        for seg in seg_nos:
+            path = self._seg_path(seg)
+            if seg < cur_seg:
+                # Fully acked before the crash; reclaim the disk.
+                try:
+                    os.unlink(path)
+                except OSError:
+                    pass
+                continue
+            records, valid, err = _scan_segment(path)
+            if err:
+                self.corrupt_segments += 1
+                self.errors.append(f"segment {seg}: {err}; kept the clean "
+                                   f"prefix ({len(records)} records)")
+                if seg == seg_nos[-1]:
+                    # Newest segment: truncate the torn tail so appends
+                    # continue from a clean boundary.
+                    try:
+                        os.truncate(path, valid)
+                    except OSError as e:
+                        self.errors.append(f"segment {seg}: truncate "
+                                           f"failed ({e})")
+            start = cur_rec if seg == cur_seg else 0
+            for idx, (off, length) in enumerate(records):
+                if idx < start:
+                    continue
+                self._entries.append((seg, idx, off, length))
+                self._pending_bytes += _HDR.size + length
+            if seg == seg_nos[-1]:
+                if seg == cur_seg and len(records) < cur_rec:
+                    # Corruption swallowed part of the ACKED region: new
+                    # appends to this file would land below the cursor and
+                    # be skipped as "already acked" on the next boot. Seal
+                    # it and start a fresh segment instead.
+                    self._active_seg = seg + 1
+                    self._active_count = 0
+                    self._active_bytes = 0
+                else:
+                    self._active_seg = seg
+                    self._active_count = len(records)
+                    self._active_bytes = valid if valid else len(MAGIC)
+        if not seg_nos:
+            # No segments on disk (fresh dir, or everything was acked and
+            # unlinked). Start a FRESH segment past the cursor: record
+            # indices within a file always start at 0 on rescan, so reusing
+            # the cursor's segment number would make its "first rec acked"
+            # offset swallow genuinely-new records after a restart.
+            self._active_seg = cur_seg + 1 if cur_seg >= 0 else 0
+            self._active_count = 0
+            self._active_bytes = 0
+        self._acked_seg, self._acked_rec = cur_seg, cur_rec
+        self._min_seg = seg_nos[0] if seg_nos else self._active_seg
+        return {"pending": len(self._entries),
+                "pending_bytes": self._pending_bytes,
+                "corrupt_segments": self.corrupt_segments,
+                "errors": list(self.errors)}
+
+    # ---------------------------------------------------------------- append
+
+    def _ensure_writer(self) -> IO[bytes]:
+        if self._f is not None:
+            return self._f
+        path = self._seg_path(self._active_seg)
+        f = open(path, "ab")
+        if f.tell() == 0:
+            f.write(MAGIC)
+            f.flush()
+        self._active_bytes = f.tell()
+        self._f = f
+        return f
+
+    def append(self, payload: bytes) -> None:
+        """Durably append one record (raises OSError if the filesystem
+        refuses — the caller counts a drop and retries on the next append,
+        the StatePersister._ensure_wal discipline)."""
+        try:
+            if self._active_bytes >= self.segment_max_bytes and self._active_count > 0:
+                self._rotate()
+            f = self._ensure_writer()
+            offset = self._active_bytes + _HDR.size
+            n = append_record(f, payload)
+            f.flush()
+            if self.fsync_each:
+                os.fsync(f.fileno())
+        except OSError:
+            # The failed write may have left a TORN partial record in the
+            # segment; appending past it would strand every later record
+            # behind the tear at the next rescan (clean-prefix semantics),
+            # and rescan indices would no longer match the cursor's.
+            # Seal the segment — already-indexed records sit before the
+            # tear and stay readable — and start fresh on the next append.
+            self._close_writer()
+            self._active_seg += 1
+            self._active_count = 0
+            self._active_bytes = 0
+            raise
+        with self._lock:
+            self._entries.append(
+                (self._active_seg, self._active_count, offset, len(payload))
+            )
+            self._pending_bytes += n
+        self._active_count += 1
+        self._active_bytes += n
+
+    def _rotate(self) -> None:
+        self._close_writer()
+        self._active_seg += 1
+        self._active_count = 0
+        self._active_bytes = 0
+        self._ensure_writer()
+
+    def _close_writer(self) -> None:
+        f = self._f
+        self._f = None
+        if f is not None:
+            try:
+                f.close()
+            except OSError:
+                pass
+
+    # --------------------------------------------------------------- consume
+
+    def peek(self) -> bytes | None:
+        """Oldest unacknowledged payload (None when drained). Re-reads from
+        disk — the backlog is never held in memory."""
+        return self._read_entry(0)
+
+    def peek_last(self) -> bytes | None:
+        """NEWEST pending payload (None when drained) — lets a consumer
+        resume monotonic bookkeeping (e.g. the egress batch sequence) from
+        the tail without materializing the whole backlog."""
+        return self._read_entry(-1)
+
+    def peek_at(self, index: int) -> bytes | None:
+        """Pending payload at ``index`` from the head (None past the end)
+        — lets a consumer walk the backlog (e.g. the egress age-cap scan)
+        without advancing the cursor."""
+        with self._lock:
+            if index >= len(self._entries):
+                return None
+        return self._read_entry(index)
+
+    def trim_to_bytes(self, max_bytes: int) -> int:
+        """Drop as many OLDEST records as needed to bring the pending
+        byte total under ``max_bytes``, in ONE cursor advance (one fsynced
+        cursor write however many records shed — a long-outage trim must
+        not pay a cursor fsync per dropped batch). Returns the count."""
+        with self._lock:
+            count = 0
+            excess = self._pending_bytes - max_bytes
+            for _seg, _idx, _off, length in self._entries:
+                if excess <= 0:
+                    break
+                excess -= _HDR.size + length
+                count += 1
+        if count == 0:
+            return 0
+        return self._advance(count)
+
+    def _read_entry(self, index: int) -> bytes | None:
+        with self._lock:
+            if not self._entries:
+                return None
+            seg, _idx, off, length = self._entries[index]
+        try:
+            with open(self._seg_path(seg), "rb") as f:
+                f.seek(off)
+                payload = f.read(length)
+        except OSError:
+            return None
+        return payload if len(payload) == length else None
+
+    def ack(self) -> None:
+        """Mark the oldest pending record delivered: advance + durably
+        persist the cursor, unlink fully-acked segments. A crash right
+        after this call must never re-deliver the record."""
+        self._advance(1)
+
+    def drop_oldest(self, n: int) -> int:
+        """Advance the cursor past up to ``n`` oldest records WITHOUT
+        delivery (backlog caps). Returns how many were dropped."""
+        return self._advance(n)
+
+    def _advance(self, n: int) -> int:
+        advanced = 0
+        with self._lock:
+            while advanced < n and self._entries:
+                seg, idx, _off, length = self._entries.popleft()
+                self._pending_bytes -= _HDR.size + length
+                self._acked_seg, self._acked_rec = seg, idx + 1
+                advanced += 1
+            head_seg = (
+                self._entries[0][0] if self._entries else self._active_seg
+            )
+            acked_seg, acked_rec = self._acked_seg, self._acked_rec
+        if advanced:
+            try:
+                atomic_write(
+                    self._cursor_path,
+                    json.dumps({"seg": acked_seg, "rec": acked_rec}).encode(),
+                )
+            except OSError as e:
+                self.errors.append(f"cursor write failed: {e}")
+            # Sweep EVERY fully-acked segment below the new head (a single
+            # multi-segment advance — e.g. an age-cap trim after a long
+            # outage — must reclaim all of them now, not at the next
+            # boot). _min_seg advances only past successful unlinks so a
+            # transient failure is retried on the next advance.
+            for seg in range(self._min_seg, head_seg):
+                if seg == self._active_seg:
+                    break
+                try:
+                    os.unlink(self._seg_path(seg))
+                except FileNotFoundError:
+                    pass
+                except OSError:
+                    break
+                self._min_seg = seg + 1
+            else:
+                self._min_seg = max(self._min_seg, head_seg)
+        return advanced
+
+    # ----------------------------------------------------------------- stats
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def pending_bytes(self) -> int:
+        with self._lock:
+            return self._pending_bytes
+
+    def close(self) -> None:
+        self._close_writer()
 
 
 # ------------------------------------------------- aggregator breaker state

@@ -408,7 +408,15 @@ class _Handler(BaseHTTPRequestHandler):
                 try:
                     detail = type(self).ready_detail_fn() or {}
                     body.update(detail)
-                    if detail.get("degraded_sources") and body["state"] == "ready":
+                    # Degraded = still serving, but an operator should
+                    # look: a source breaker stuck open across probes, or
+                    # the egress receiver unreachable past the same reopen
+                    # threshold (batches buffering to disk, not flowing).
+                    egress = detail.get("egress") or {}
+                    if body["state"] == "ready" and (
+                        detail.get("degraded_sources")
+                        or egress.get("degraded")
+                    ):
                         body["state"] = "degraded"
                 except Exception:  # noqa: BLE001 — detail must not break probes
                     pass

@@ -277,6 +277,42 @@ def persist_line(state_dir: str) -> str | None:
             f"wal {fmt_bytes(s['wal_bytes'])}) · {warm}")
 
 
+def egress_line(egress_url: str, egress_dir: str) -> str | None:
+    """``egress: …`` footer: receiver/breaker state, backlog bytes/age,
+    last-send latency — the operator-facing read of the
+    ``tpu_exporter_egress_*`` surface, mirroring the ``state-dir:`` footer.
+    Reads the shipper's on-disk status sidecar plus segment sizes; a
+    missing dir means egress has never run here."""
+    from tpu_pod_exporter.egress import egress_dir_summary
+
+    s = egress_dir_summary(egress_dir)
+    if not s["exists"]:
+        return (f"egress: {egress_url} (dir {egress_dir} missing — "
+                f"no batches shipped yet)")
+    st = s["status"] or {}
+    backlog = st.get("backlog_batches")
+    parts = [f"egress: {egress_url}"]
+    parts.append(f"breaker {st.get('breaker', '?')}")
+    if backlog is not None:
+        parts.append(
+            f"backlog {backlog} batch(es) / {fmt_bytes(st.get('backlog_bytes', 0))}"
+        )
+    else:
+        parts.append(f"buffer {fmt_bytes(s['segment_bytes'])} on disk")
+    ok_wall = st.get("last_send_ok_wall") or 0
+    if ok_wall:
+        parts.append(
+            f"last send ok {max(time.time() - ok_wall, 0.0):.1f}s ago "
+            f"({1e3 * st.get('last_send_latency_s', 0.0):.1f}ms)"
+        )
+    else:
+        parts.append("no send acknowledged yet")
+    err = st.get("last_error")
+    if err:
+        parts.append(f"last error: {err}")
+    return " · ".join(parts)
+
+
 # Series name the watch-mode phase breakdown stores its timings under — the
 # same family the exporter's per-phase histogram publishes, so the footer
 # reads as a local preview of the daemon's phase heatmap.
@@ -453,9 +489,15 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
             from tpu_pod_exporter.persist import state_dir_summary
 
             persist = state_dir_summary(cfg.state_dir)
+        egress = None
+        if cfg.egress_url:
+            from tpu_pod_exporter.egress import egress_dir_summary
+
+            egress = egress_dir_summary(cfg.egress_dir)
         print(json.dumps({
             "accelerator": topo.accelerator,
             "persist": persist,
+            "egress": egress,
             "slice_name": topo.slice_name,
             "host": topo.host,
             "worker_id": topo.worker_id,
@@ -499,6 +541,11 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
             print(line)
     if cfg.state_dir:
         line = persist_line(cfg.state_dir)
+        if line:
+            print()
+            print(line)
+    if cfg.egress_url:
+        line = egress_line(cfg.egress_url, cfg.egress_dir)
         if line:
             print()
             print(line)

@@ -7,8 +7,11 @@ This module closes that gap with three zero-dependency pieces:
 
 - **Spans.** Every collector poll (and every aggregator round) becomes a
   :class:`PollTrace`: a root span plus one child span per supervised phase
-  (device read, attribution, process scan, join, publish, history append /
-  per-target scrape, history fallback). Each span carries a status
+  (device read, attribution, process scan, join, publish, history append,
+  persist, egress / per-target scrape, history fallback). The post-swap
+  phases (history append, persist, egress) are deliberately excluded from
+  the publish/total timings they would otherwise inflate — each is its own
+  span and its own phase-histogram label. Each span carries a status
   (``ok|err|abandoned|skipped``), the source breaker's state at entry, and
   byte/series counts, and collects free-form events — the supervisor and
   the chaos injector annotate the active span, so a wedge incident reads
