@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo egress-demo egress-drain-check clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check clean
 
 test:
 	python -m pytest tests/ -q
@@ -92,6 +92,23 @@ persist-overhead:
 # runners — see .github/workflows/ci.yml).
 fleet-query-demo:
 	python -m tpu_pod_exporter.loadgen.fleet --targets 64 --budget-ms 1500
+
+# Sharded HA aggregation tree acceptance (deploy/RUNBOOK.md "Leaf death
+# playbook"): 1000 synthetic node targets behind 8 consistent-hash leaf
+# shards (HA pairs) and a freshest-wins root merge tier, everything
+# talking real HTTP. The scripted timeline (chaos.LeafKillHook) staggers
+# every HA pair to prove freshest-wins dedup, SIGKILLs one leaf MID-ROUND
+# (zero series lost at the root, twin staleness within one round),
+# restarts it on its state dir (breaker + shard-map carryover), and runs
+# a 32-target churn wave through the shared targets file (assignment
+# moves bounded by churned + targets/shards; every tier reshards live).
+# Rollups are asserted equal to a flat single-aggregator oracle over the
+# same scrape set at every checkpoint. CI runs a reduced-target smoke
+# (see .github/workflows/ci.yml) and uploads the state dir on failure.
+shard-demo:
+	python -m tpu_pod_exporter.loadgen.fleet --mode shard --targets 1000 \
+		--shards 8 --chips 2 --churn 32 --round-budget-s 15 \
+		--state-root shard-demo-state
 
 # Remote-write egress acceptance (deploy/RUNBOOK.md "Egress backlog
 # playbook"): a seeded chaos receiver (hang/5xx/429/mid-body truncation)

@@ -31,6 +31,7 @@ import argparse
 import inspect
 import json
 import logging
+import os
 import signal
 import threading
 import time
@@ -152,16 +153,47 @@ class _SliceAgg:
         self.dcn_bw = 0.0
         self.dcn_n = 0
 
+    # Count/flag surface consumed by emit_rollups — the same attribute
+    # names tpu_pod_exporter.shard's SliceStats (rebuilt at the root tier
+    # from tpu_leaf_slice_component series) exposes, so one emit path
+    # serves both the flat aggregator and the sharded tree's root.
+    @property
+    def hosts_n(self) -> int:
+        return len(self.hosts)
+
+    @property
+    def used_n(self) -> int:
+        return len(self.used_chips)
+
+    @property
+    def total_n(self) -> int:
+        return len(self.total_chips)
+
+    @property
+    def coverage_eq(self) -> bool:
+        """Used and total HBM samples cover the SAME chip set — the slice
+        percent is emitted only then (see emit_rollups)."""
+        return self.used_chips == self.total_chips
+
+    def orphan_hosts(self) -> set[str]:
+        """Hosts contributing per-chip series but no tpu_chip_info rows
+        (mixed-fleet diagnostic; empty at the root tier, where the leaf
+        already warned)."""
+        return self.chip_series_hosts - self.hosts
+
 
 class _GroupAgg:
     """Mutable per-multislice-group accumulator for one round."""
 
-    __slots__ = ("slices", "hosts", "chips", "hbm_used", "hbm_used_n",
+    __slots__ = ("slices", "hosts_n", "chips", "hbm_used", "hbm_used_n",
                  "ici_bw", "ici_n", "dcn_bw", "dcn_n", "expected_slices")
 
     def __init__(self) -> None:
         self.slices: set[tuple[str, str]] = set()
-        self.hosts: set[str] = set()
+        # Count, not a set: slice hosts are disjoint (one host belongs to
+        # one slice), so summing per-slice counts equals the union size —
+        # and the root tier only has counts to sum.
+        self.hosts_n = 0
         self.chips = 0
         self.hbm_used = 0.0
         self.hbm_used_n = 0
@@ -182,6 +214,348 @@ class _WorkloadAgg:
         # pods emitted chip_count but no hbm series must omit workload HBM.
         self.hbm_used_n = 0
         self.hosts: set[str] = set()
+
+    @property
+    def hosts_n(self) -> int:
+        return len(self.hosts)
+
+
+def emit_rollups(b: SnapshotBuilder, slices, workloads, slice_groups,
+                 rlog=None) -> None:
+    """Fold the round accumulators into rollup series on ``b`` — the ONE
+    emit path for ``tpu_slice_*`` / ``tpu_multislice_*`` / ``tpu_workload_*``.
+
+    Shared between :class:`SliceAggregator` (accumulators fresh from
+    ``_consume``) and the sharded tree's root tier
+    (:class:`tpu_pod_exporter.shard.RootAggregator`, accumulators rebuilt
+    by summing per-shard ``tpu_leaf_*`` components), so the root's fleet
+    rollups cannot drift from what a flat aggregator over the same scrape
+    set would publish — the shard-demo asserts them equal against exactly
+    that oracle. Consumes only the count/flag surface (``hosts_n``,
+    ``used_n``, ``coverage_eq``, …), never the identity sets, because the
+    root only has counts."""
+    for key, agg in slices.items():
+        # Mixed-fleet diagnostic (advisor r4): an exporter older than the
+        # unconditional-chip_info change contributes HBM sums while its
+        # chips/hosts_reporting read 0 — a silent undercount during
+        # rolling upgrades. Not supported, but loudly not silently.
+        orphan_hosts = agg.orphan_hosts()
+        if orphan_hosts and rlog is not None:
+            rlog.warning(
+                f"orphan-hbm:{key[0]}",
+                "slice %s: host(s) %s contribute per-chip series but "
+                "zero tpu_chip_info rows — exporter too old? chips/"
+                "hosts_reporting will undercount",
+                key[0], sorted(orphan_hosts),
+            )
+        b.add(schema.TPU_SLICE_HOSTS_REPORTING, float(agg.hosts_n), key)
+        b.add(schema.TPU_SLICE_CHIP_COUNT, float(agg.chips), key)
+        # Emitted only when at least one chip actually reported HBM —
+        # absent beats fake-zero, same rule the exporter applies to
+        # per-chip and per-pod series.
+        if agg.used_n:
+            b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
+        if agg.total_n:
+            b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
+        # Percent only when used and total cover the SAME chip set —
+        # mismatched coverage (e.g. a runtime serving bytes_in_use but
+        # no bytes_limit on some chips) would yield a misleading or
+        # >100% ratio (advisor r4) — and only over a positive capacity:
+        # a percent of zero total is undefined, and 0.0 would read as
+        # "idle" (same rule as the per-chip series).
+        if agg.used_n and agg.coverage_eq and agg.hbm_total > 0:
+            b.add(
+                schema.TPU_SLICE_HBM_USED_PERCENT,
+                schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
+                key,
+            )
+        if agg.duty_n:
+            b.add(
+                schema.TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
+                agg.duty_sum / agg.duty_n,
+                key,
+            )
+        if agg.ici_n:
+            b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
+        if agg.dcn_n:
+            b.add(schema.TPU_SLICE_DCN_BYTES_PER_SECOND, agg.dcn_bw, key)
+
+    # Multi-slice group rollups: join slices to groups via the
+    # tpu_host_info membership map (BASELINE config 5). A slice without
+    # a group (single-slice deployment) contributes to no group series,
+    # and every sum keeps the absent-beats-fake-zero sample-count guards.
+    groups: dict[str, _GroupAgg] = {}
+    for skey, agg in slices.items():
+        membership = slice_groups.get(skey)
+        if membership is None:
+            continue
+        group, nslices_str = membership
+        g = groups.get(group)
+        if g is None:
+            g = groups[group] = _GroupAgg()
+        g.slices.add(skey)
+        g.hosts_n += agg.hosts_n
+        g.chips += agg.chips
+        g.hbm_used += agg.hbm_used
+        g.hbm_used_n += agg.used_n
+        g.ici_bw += agg.ici_bw
+        g.ici_n += agg.ici_n
+        g.dcn_bw += agg.dcn_bw
+        g.dcn_n += agg.dcn_n
+        try:
+            g.expected_slices = max(g.expected_slices, int(nslices_str))
+        except ValueError:
+            pass
+    for group, g in groups.items():
+        gkey = (group,)
+        b.add(schema.TPU_MULTISLICE_SLICES_REPORTING, float(len(g.slices)), gkey)
+        if g.expected_slices > 0:
+            b.add(
+                schema.TPU_MULTISLICE_EXPECTED_SLICES,
+                float(g.expected_slices), gkey,
+            )
+        b.add(schema.TPU_MULTISLICE_HOSTS_REPORTING, float(g.hosts_n), gkey)
+        b.add(schema.TPU_MULTISLICE_CHIP_COUNT, float(g.chips), gkey)
+        if g.hbm_used_n:
+            b.add(schema.TPU_MULTISLICE_HBM_USED_BYTES, g.hbm_used, gkey)
+        if g.ici_n:
+            b.add(schema.TPU_MULTISLICE_ICI_BYTES_PER_SECOND, g.ici_bw, gkey)
+        if g.dcn_n:
+            b.add(schema.TPU_MULTISLICE_DCN_BYTES_PER_SECOND, g.dcn_bw, gkey)
+
+    for key, w in workloads.items():
+        b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
+        if w.hbm_used_n:  # absent beats fake-zero (advisor r4, medium)
+            b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
+        b.add(schema.TPU_WORKLOAD_HOSTS, float(w.hosts_n), key)
+
+
+def read_targets_file(path: str) -> tuple[str, ...]:
+    """Parse a targets file: one ``host:port`` (or URL) per line, commas
+    also accepted, ``#`` comments and blanks ignored. Deduped in order,
+    same as ``--targets``. Raises OSError on an unreadable file — the
+    caller decides whether that is fatal (boot) or a keep-last-known
+    (reload)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    out: list[str] = []
+    for line in text.split("\n"):
+        line = line.split("#", 1)[0]
+        for part in line.split(","):
+            part = part.strip()
+            if part:
+                out.append(part)
+    return tuple(dict.fromkeys(out))
+
+
+class TargetSet:
+    """Dynamic scrape-target membership plus everything keyed per target —
+    circuit breakers (with optional ``persist.BreakerStateFile`` carryover)
+    and parse-layout caches.
+
+    Extracted from :class:`SliceAggregator` (which used to rebuild its
+    target tuple from argv once, at construction) so that target add/remove
+    no longer requires a restart, and so the sharded leaf tier
+    (:class:`tpu_pod_exporter.shard.LeafAggregator`) can share it: a leaf's
+    membership is ``filter_fn`` (its consistent-hash shard) applied to the
+    same targets file every other leaf reads.
+
+    Sources of membership, in precedence order:
+
+    - ``targets_file``: one target per line (see :func:`read_targets_file`),
+      re-read on :meth:`refresh` whenever its mtime changes — the live
+      resharding path. An unreadable or vanished file keeps the last known
+      membership (a fat-fingered ``mv`` must not empty the fleet view).
+    - the static ``targets`` tuple: the classic ``--targets`` flag, used as
+      the whole membership when no file is given, and as the boot fallback
+      while the file is unreadable.
+
+    Thread model: all mutation happens on the aggregator's round thread
+    (``refresh()`` at round start). Concurrent readers (fleet query plane
+    HTTP threads) do per-key ``get`` on the breakers dict — mutated in
+    place, one GIL-atomic op at a time, never re-bound — and read the
+    targets tuple, which is swapped atomically.
+    """
+
+    def __init__(
+        self,
+        targets=(),
+        targets_file: str = "",
+        filter_fn=None,
+        breaker_failures: int = 0,
+        breaker_backoff_s: float = 10.0,
+        breaker_backoff_max_s: float = 120.0,
+        breaker_store=None,
+        wallclock=time.time,
+    ) -> None:
+        self._file = targets_file
+        self._file_mtime: float | None = None
+        self._filter = filter_fn
+        self._wallclock = wallclock
+        self._breaker_failures = breaker_failures
+        self._breaker_backoff_s = breaker_backoff_s
+        self._breaker_backoff_max_s = breaker_backoff_max_s
+        self._breaker_store = breaker_store
+        # Saved breaker docs from a previous process: consumed lazily as
+        # targets (re)appear, so a target that reshards INTO this leaf
+        # after a restart still inherits its quarantine.
+        self._saved_breakers: dict[str, dict] = (
+            breaker_store.load() if breaker_store is not None else {}
+        )
+        self._rlog = RateLimitedLogger(log)
+        self._breaker_sigs: dict[str, tuple] = {}
+        self.breakers: dict[str, CircuitBreaker] | None = (
+            {} if breaker_failures > 0 else None
+        )
+        self.layouts: dict[str, LayoutCache] = {}
+        self.targets: tuple[str, ...] = ()
+        # Cumulative membership changes (adds + removes) — the leaf-side
+        # reshard counter (tpu_leaf_reshard_moves_total).
+        self.moves = 0
+        base = tuple(dict.fromkeys(t.strip() for t in targets if t.strip()))
+        if targets_file:
+            try:
+                # mtime BEFORE contents: if the file is replaced between
+                # the two calls we record the OLD file's mtime against
+                # its own contents and the next refresh re-reads — the
+                # reverse order could pin stale membership forever (new
+                # mtime recorded against old contents).
+                self._file_mtime = os.path.getmtime(targets_file)
+                base = read_targets_file(targets_file)
+            except OSError as e:
+                self._file_mtime = None
+                log.warning(
+                    "targets file %s unreadable at boot (%s); starting "
+                    "from --targets (%d entries) until it appears",
+                    targets_file, e, len(base),
+                )
+        self.set_targets(base)
+        self.moves = 0  # boot population is not churn
+
+    def set_targets(self, targets) -> tuple[int, int]:
+        """Replace membership; returns (added, removed) counts. Per-target
+        state is created for newcomers (breakers restored from the saved
+        store when present) and dropped for leavers."""
+        new = tuple(dict.fromkeys(t.strip() for t in targets if t.strip()))
+        if self._filter is not None:
+            new = tuple(self._filter(new))
+        old = self.targets
+        if new == old:
+            return 0, 0
+        old_set, new_set = set(old), set(new)
+        added = [t for t in new if t not in old_set]
+        removed = [t for t in old if t not in new_set]
+        for t in added:
+            self.layouts[t] = LayoutCache()
+            if self.breakers is not None:
+                br = CircuitBreaker(
+                    failure_threshold=self._breaker_failures,
+                    backoff_base_s=self._breaker_backoff_s,
+                    backoff_max_s=self._breaker_backoff_max_s,
+                )
+                # pop, not get: the doc is a snapshot of a PAST state.
+                # Consumed once, it must not re-quarantine this target on
+                # a later remove/re-add bounce after it has RECOVERED —
+                # the removal path below stashes current state for the
+                # genuine bounce case.
+                doc = self._saved_breakers.pop(t, None)
+                if doc:
+                    try:
+                        br.restore_state(doc, wallclock=self._wallclock)
+                    except Exception as e:  # noqa: BLE001 — never refuse to start
+                        log.warning("breaker restore for %s failed: %s", t, e)
+                if br.state != CLOSED:
+                    log.warning(
+                        "target %s restored %s (reopens=%d, next probe "
+                        "in %.1fs) — quarantine carried across restart",
+                        t, br.state, br.reopens, br.seconds_until_probe,
+                    )
+                self._breaker_sigs[t] = (br.state, br.reopens)
+                self.breakers[t] = br
+        for t in removed:
+            self.layouts.pop(t, None)
+            if self.breakers is not None:
+                br = self.breakers.pop(t, None)
+                if br is not None and br.state != CLOSED:
+                    # Stash the live quarantine: a target that bounces out
+                    # and back (partial file read, flapping inventory)
+                    # must restore its backoff, not re-learn a black hole
+                    # from closed. Memory-bounded by churned-target count;
+                    # the on-disk file only ever holds CURRENT targets.
+                    try:
+                        self._saved_breakers[t] = br.export_state(
+                            wallclock=self._wallclock)
+                    except Exception:  # noqa: BLE001 — stash is best-effort
+                        pass
+            self._breaker_sigs.pop(t, None)
+        self.targets = new
+        self.moves += len(added) + len(removed)
+        return len(added), len(removed)
+
+    def refresh(self) -> tuple[int, int]:
+        """Re-read the targets file when its mtime moved; returns (added,
+        removed). Called at round start on the round thread. No file =
+        static membership, always (0, 0) here."""
+        if not self._file:
+            return 0, 0
+        try:
+            mtime = os.path.getmtime(self._file)
+        except OSError:
+            # Vanished mid-flight: keep last known membership; it will be
+            # re-read when the file reappears with a fresh mtime.
+            return 0, 0
+        if self._file_mtime is not None and mtime == self._file_mtime:
+            return 0, 0
+        try:
+            targets = read_targets_file(self._file)
+        except OSError as e:
+            log.warning("targets file %s unreadable on reload (%s); "
+                        "keeping current %d targets",
+                        self._file, e, len(self.targets))
+            return 0, 0
+        self._file_mtime = mtime
+        if not targets and self.targets:
+            # A readable-but-EMPTY file on reload is overwhelmingly a torn
+            # in-place write (shell `>` truncate-then-write) — not an
+            # operator deleting the whole fleet. Applying it would drop
+            # every breaker and empty the fleet view for a round; keep
+            # the membership and wait for the next mtime bump (a genuine
+            # full teardown restarts the process instead).
+            log.warning(
+                "targets file %s read EMPTY on reload; keeping current %d "
+                "targets (truncated mid-write? restart to force empty)",
+                self._file, len(self.targets),
+            )
+            return 0, 0
+        added, removed = self.set_targets(targets)
+        if added or removed:
+            log.info("targets file %s reloaded: +%d/-%d targets (now %d)",
+                     self._file, added, removed, len(self.targets))
+        return added, removed
+
+    def maybe_save_breakers(self, force: bool = False) -> None:
+        """Persist breaker state after rounds where any breaker changed
+        state/reopen count (transitions, not per-round churn — the file is
+        rewritten a handful of times per incident, not 1 Hz)."""
+        if self._breaker_store is None or self.breakers is None:
+            return
+        changed = force
+        for t, br in self.breakers.items():
+            sig = (br.state, br.reopens)
+            if self._breaker_sigs.get(t) != sig:
+                self._breaker_sigs[t] = sig
+                changed = True
+        if changed:
+            try:
+                self._breaker_store.save({
+                    t: br.export_state(wallclock=self._wallclock)
+                    for t, br in self.breakers.items()
+                })
+            except Exception as e:  # noqa: BLE001 — persistence must not fail rounds
+                # Rate-limited: a full disk plus a flapping breaker would
+                # otherwise emit one line per round for the whole incident.
+                self._rlog.warning("breaker_save",
+                                   "breaker state save failed: %s", e)
 
 
 class RoundRecorder:
@@ -295,10 +669,11 @@ class SliceAggregator:
         breaker_store=None,  # persist.BreakerStateFile; None = no persistence
         fleet=None,  # fleet.FleetQueryPlane; publishes its self-metrics here
         shipper=None,  # egress.RemoteWriteShipper; None = no push egress
+        targets_file: str = "",  # live membership: re-read on mtime change
+        target_filter=None,  # (tuple) -> iterable; the leaf tier's shard cut
     ) -> None:
-        if not targets:
+        if not targets and not targets_file:
             raise ValueError("aggregator needs at least one target")
-        self._targets = targets
         # Federated /api/v1 query plane (tpu_pod_exporter.fleet): attached
         # after construction (it shares this aggregator's breakers), it
         # serves fan-out queries on HTTP handler threads; the round loop's
@@ -340,74 +715,75 @@ class SliceAggregator:
         # tpu_aggregator_history_fallbacks_total.
         self._history_window_s = history_fallback_window_s
         self._history_fetch = history_fetch
-        # Per-target circuit breakers (tpu_pod_exporter.supervisor): a
-        # persistently-down target is QUARANTINED with exponential
-        # backoff+jitter instead of costing a full timeout_s in the scrape
-        # pool every round — at 64 targets and 2 s timeouts a handful of
-        # black-holed hosts would otherwise dominate round latency. While a
-        # target is quarantined its history fallback is skipped too (same
-        # dead endpoint). breaker_failures=0 disables (every target scraped
-        # every round, the pre-breaker behaviour).
-        self._breakers: dict[str, CircuitBreaker] | None = None
-        # Restart survivability (tpu_pod_exporter.persist): quarantine
-        # state is restored at boot — a restarted aggregator must not
-        # re-learn every black-holed target from closed, burning
-        # targets × timeout_s per round until the breakers re-open — and
-        # saved whenever any breaker changes state (atomic JSON, tolerant
-        # load; a corrupt file just means fresh breakers).
-        self._breaker_store = breaker_store
-        self._breaker_sigs: dict[str, tuple] = {}
-        if breaker_failures > 0:
-            self._breakers = {
-                t: CircuitBreaker(
-                    failure_threshold=breaker_failures,
-                    backoff_base_s=breaker_backoff_s,
-                    backoff_max_s=breaker_backoff_max_s,
-                )
-                for t in targets
-            }
-            if breaker_store is not None:
-                saved = breaker_store.load()
-                for t, br in self._breakers.items():
-                    doc = saved.get(t)
-                    if doc:
-                        try:
-                            br.restore_state(doc, wallclock=wallclock)
-                        except Exception as e:  # noqa: BLE001 — never refuse to start
-                            log.warning("breaker restore for %s failed: %s",
-                                        t, e)
-                    if br.state != CLOSED:
-                        log.warning(
-                            "target %s restored %s (reopens=%d, next probe "
-                            "in %.1fs) — quarantine carried across restart",
-                            t, br.state, br.reopens, br.seconds_until_probe,
-                        )
-                    self._breaker_sigs[t] = (br.state, br.reopens)
+        # Per-target state lives in a TargetSet: circuit breakers
+        # (tpu_pod_exporter.supervisor — a persistently-down target is
+        # QUARANTINED with exponential backoff+jitter instead of costing a
+        # full timeout_s in the scrape pool every round; while quarantined
+        # its history fallback is skipped too; breaker_failures=0
+        # disables), quarantine carryover across restarts
+        # (tpu_pod_exporter.persist via breaker_store), parse-layout
+        # caches (value-only re-parse between churn events — the
+        # parse-side twin of the exporter's PrefixCache), and LIVE
+        # membership: a --targets-file is re-read at round start whenever
+        # its mtime changes, so target add/remove no longer requires a
+        # restart, and the sharded leaf tier applies its consistent-hash
+        # cut via target_filter.
+        self._tset = TargetSet(
+            targets,
+            targets_file=targets_file,
+            filter_fn=target_filter,
+            breaker_failures=breaker_failures,
+            breaker_backoff_s=breaker_backoff_s,
+            breaker_backoff_max_s=breaker_backoff_max_s,
+            breaker_store=breaker_store,
+            wallclock=wallclock,
+        )
         self._wallclock = wallclock
         self._counters = CounterStore()
         self._rlog = RateLimitedLogger(log)
-        # Per-target parse layouts (value-only re-parse between churn
-        # events — the parse-side twin of the exporter's PrefixCache).
-        # Bounded: targets are fixed at construction.
-        self._parse_layouts: dict[str, LayoutCache] = {
-            t: LayoutCache() for t in targets
-        }
         # Latency distributions (same contract as the exporter's: p99
         # computable from the exposition). Round durations observe after
         # the swap, so they land one round behind — fine for cumulative
         # histograms.
         self._round_hist = HistogramStore(schema.TPU_AGG_ROUND_HIST)
         self._scrape_hist = HistogramStore(schema.TPU_AGG_TARGET_SCRAPE_HIST)
+        # Cap, not current membership: ThreadPoolExecutor spawns workers
+        # lazily (one per pending task up to the cap), so a 2-target
+        # aggregator never creates 16 threads — while a targets-file
+        # deployment that boots before the file exists still gets full
+        # parallelism when the file appears (membership is LIVE; a pool
+        # sized at boot would serialize the grown fleet forever).
         self._pool = ThreadPoolExecutor(
-            max_workers=min(len(targets), 16),
+            max_workers=16,
             thread_name_prefix="tpu-agg-scrape",
         )
+
+    # Delegating views over the TargetSet: membership and per-target state
+    # are owned there; everything below reads the live view.
+    @property
+    def _targets(self) -> tuple[str, ...]:
+        return self._tset.targets
+
+    @property
+    def _breakers(self) -> "dict[str, CircuitBreaker] | None":
+        return self._tset.breakers
+
+    @property
+    def _parse_layouts(self) -> dict[str, LayoutCache]:
+        return self._tset.layouts
+
+    @property
+    def targets(self) -> tuple[str, ...]:
+        """Current membership (live view — changes on targets-file reload)."""
+        return self._tset.targets
 
     @property
     def breakers(self) -> "dict[str, CircuitBreaker] | None":
         """Per-target breaker map (None when disabled) — shared read-only
-        with the fleet query plane for its quarantine-aware skip."""
-        return self._breakers
+        with the fleet query plane for its quarantine-aware skip. The dict
+        object is stable across resharding (mutated in place), so holders
+        of this reference always see current membership."""
+        return self._tset.breakers
 
     def set_fleet(self, fleet) -> None:
         """Attach the federated query plane (constructed after the
@@ -419,6 +795,25 @@ class SliceAggregator:
     def poll_once(self) -> None:
         t0 = time.monotonic()
         self.rounds += 1
+        # Live membership: apply a changed targets file BEFORE the round
+        # snapshot, so this round already scrapes the new set. The tuple
+        # read below is the round's frozen view — per-target state for
+        # everything in it exists until at least the next refresh.
+        _added, removed = self._tset.refresh()
+        if removed:
+            # Per-target counter state follows membership out: without
+            # this, every target that ever errored keeps its series in
+            # the exposition (and its entry in RSS) forever on a
+            # churning fleet — same prune discipline as the exporter's
+            # chip state.
+            keep = {
+                (name, (t,))
+                for name in (schema.TPU_AGG_SCRAPE_ERRORS_TOTAL.name,
+                             schema.TPU_AGG_HISTORY_FALLBACKS_TOTAL.name)
+                for t in self._tset.targets
+            }
+            self._counters.prune(keep)
+        round_targets = self._tset.targets
         tr = self._tracer.start_poll() if self._tracer is not None else None
         # Round-local quarantine set: targets whose breaker skipped the
         # scrape entirely this round (set.add is GIL-atomic; each pool
@@ -473,7 +868,7 @@ class SliceAggregator:
             return out
 
         results = list(
-            self._pool.map(scrape, self._targets)
+            self._pool.map(scrape, round_targets)
         )  # [(target, text|None, duration_s)]
         if self._recorder is not None:
             try:
@@ -518,7 +913,7 @@ class SliceAggregator:
             self._tracer.finish(
                 tr,
                 status="ok" if ok_n else "err",
-                targets=len(self._targets), ok=ok_n,
+                targets=len(round_targets), ok=ok_n,
                 quarantined=len(quarantined), fallbacks=len(fallbacks),
             )
         # AFTER the round's spans close: the save fsyncs twice, and disk
@@ -661,11 +1056,17 @@ class SliceAggregator:
                     )
             b.add(schema.TPU_AGG_TARGET_UP, 1.0 if ok else 0.0, (target,))
             if self._breakers is not None:
-                b.add(
-                    schema.TPU_AGG_TARGET_BREAKER_STATE,
-                    STATE_VALUES[self._breakers[target].state],
-                    (target,),
-                )
+                # .get: a refresh between this round's snapshot and publish
+                # cannot happen (same thread), but a target REMOVED by the
+                # refresh at the top of this very round still has its round
+                # result here only if it was in the snapshot — guard anyway.
+                br = self._breakers.get(target)
+                if br is not None:
+                    b.add(
+                        schema.TPU_AGG_TARGET_BREAKER_STATE,
+                        STATE_VALUES[br.state],
+                        (target,),
+                    )
             b.add(schema.TPU_AGG_SCRAPE_DURATION_SECONDS, duration_s, (target,))
             if text is not None:
                 # Successful fetches only: a down target's timeout (~2 s
@@ -674,104 +1075,13 @@ class SliceAggregator:
                 # visible via target_up / scrape_errors instead.
                 self._scrape_hist.observe(duration_s)
 
-        for key, agg in slices.items():
-            # Mixed-fleet diagnostic (advisor r4): an exporter older than the
-            # unconditional-chip_info change contributes HBM sums while its
-            # chips/hosts_reporting read 0 — a silent undercount during
-            # rolling upgrades. Not supported, but loudly not silently.
-            orphan_hosts = agg.chip_series_hosts - agg.hosts
-            if orphan_hosts:
-                self._rlog.warning(
-                    f"orphan-hbm:{key[0]}",
-                    "slice %s: host(s) %s contribute per-chip series but "
-                    "zero tpu_chip_info rows — exporter too old? chips/"
-                    "hosts_reporting will undercount",
-                    key[0], sorted(orphan_hosts),
-                )
-            b.add(schema.TPU_SLICE_HOSTS_REPORTING, float(len(agg.hosts)), key)
-            b.add(schema.TPU_SLICE_CHIP_COUNT, float(agg.chips), key)
-            # Emitted only when at least one chip actually reported HBM —
-            # absent beats fake-zero, same rule the exporter applies to
-            # per-chip and per-pod series.
-            if agg.used_chips:
-                b.add(schema.TPU_SLICE_HBM_USED_BYTES, agg.hbm_used, key)
-            if agg.total_chips:
-                b.add(schema.TPU_SLICE_HBM_TOTAL_BYTES, agg.hbm_total, key)
-            # Percent only when used and total cover the SAME chip set —
-            # mismatched coverage (e.g. a runtime serving bytes_in_use but
-            # no bytes_limit on some chips) would yield a misleading or
-            # >100% ratio (advisor r4) — and only over a positive capacity:
-            # a percent of zero total is undefined, and 0.0 would read as
-            # "idle" (same rule as the per-chip series).
-            if (
-                agg.used_chips
-                and agg.used_chips == agg.total_chips
-                and agg.hbm_total > 0
-            ):
-                b.add(
-                    schema.TPU_SLICE_HBM_USED_PERCENT,
-                    schema.hbm_used_percent(agg.hbm_used, agg.hbm_total),
-                    key,
-                )
-            if agg.duty_n:
-                b.add(
-                    schema.TPU_SLICE_DUTY_CYCLE_AVG_PERCENT,
-                    agg.duty_sum / agg.duty_n,
-                    key,
-                )
-            if agg.ici_n:
-                b.add(schema.TPU_SLICE_ICI_BYTES_PER_SECOND, agg.ici_bw, key)
-            if agg.dcn_n:
-                b.add(schema.TPU_SLICE_DCN_BYTES_PER_SECOND, agg.dcn_bw, key)
-
-        # Multi-slice group rollups: join slices to groups via the
-        # tpu_host_info membership map (BASELINE config 5). A slice without
-        # a group (single-slice deployment) contributes to no group series,
-        # and every sum keeps the absent-beats-fake-zero sample-count guards.
-        groups: dict[str, _GroupAgg] = {}
-        for skey, agg in slices.items():
-            membership = slice_groups.get(skey)
-            if membership is None:
-                continue
-            group, nslices_str = membership
-            g = groups.get(group)
-            if g is None:
-                g = groups[group] = _GroupAgg()
-            g.slices.add(skey)
-            g.hosts |= agg.hosts
-            g.chips += agg.chips
-            g.hbm_used += agg.hbm_used
-            g.hbm_used_n += len(agg.used_chips)
-            g.ici_bw += agg.ici_bw
-            g.ici_n += agg.ici_n
-            g.dcn_bw += agg.dcn_bw
-            g.dcn_n += agg.dcn_n
-            try:
-                g.expected_slices = max(g.expected_slices, int(nslices_str))
-            except ValueError:
-                pass
-        for group, g in groups.items():
-            gkey = (group,)
-            b.add(schema.TPU_MULTISLICE_SLICES_REPORTING, float(len(g.slices)), gkey)
-            if g.expected_slices > 0:
-                b.add(
-                    schema.TPU_MULTISLICE_EXPECTED_SLICES,
-                    float(g.expected_slices), gkey,
-                )
-            b.add(schema.TPU_MULTISLICE_HOSTS_REPORTING, float(len(g.hosts)), gkey)
-            b.add(schema.TPU_MULTISLICE_CHIP_COUNT, float(g.chips), gkey)
-            if g.hbm_used_n:
-                b.add(schema.TPU_MULTISLICE_HBM_USED_BYTES, g.hbm_used, gkey)
-            if g.ici_n:
-                b.add(schema.TPU_MULTISLICE_ICI_BYTES_PER_SECOND, g.ici_bw, gkey)
-            if g.dcn_n:
-                b.add(schema.TPU_MULTISLICE_DCN_BYTES_PER_SECOND, g.dcn_bw, gkey)
-
-        for key, w in workloads.items():
-            b.add(schema.TPU_WORKLOAD_CHIP_COUNT, w.chips, key)
-            if w.hbm_used_n:  # absent beats fake-zero (advisor r4, medium)
-                b.add(schema.TPU_WORKLOAD_HBM_USED_BYTES, w.hbm_used, key)
-            b.add(schema.TPU_WORKLOAD_HOSTS, float(len(w.hosts)), key)
+        # One emit path for every tier: the same function the sharded
+        # tree's root uses over accumulators rebuilt from leaf components,
+        # so flat and sharded rollups cannot drift (shard-demo oracle).
+        emit_rollups(b, slices, workloads, slice_groups, rlog=self._rlog)
+        # Subclass hook (the leaf tier emits its tpu_leaf_* component
+        # series here); the base aggregator adds nothing.
+        self._emit_extra(b, slices, workloads, slice_groups)
 
         if self._fleet is not None:
             try:
@@ -936,6 +1246,9 @@ class SliceAggregator:
             "targets": list(self._targets),
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
+            # Cumulative membership changes (targets-file reloads / leaf
+            # resharding); 0 forever on a static --targets deployment.
+            "target_moves": self._tset.moves,
             # Federated query plane occupancy (None = fleet queries off).
             "fleet_query": (
                 self._fleet.stats() if self._fleet is not None else None
@@ -983,27 +1296,16 @@ class SliceAggregator:
             ),
         }
 
+    def _emit_extra(self, b, slices, workloads, slice_groups) -> None:
+        """Subclass hook, called once per round after the rollups landed on
+        the builder and before the self-metrics: the sharded leaf tier
+        (tpu_pod_exporter.shard.LeafAggregator) emits its accumulator
+        component series here. Base aggregator: nothing."""
+
     def _maybe_save_breakers(self, force: bool = False) -> None:
-        """Persist target breaker state after rounds where any breaker
-        changed state/reopen count (transitions, not per-round churn — the
-        file is rewritten a handful of times per incident, not 1 Hz)."""
-        if self._breaker_store is None or self._breakers is None:
-            return
-        changed = force
-        for t, br in self._breakers.items():
-            sig = (br.state, br.reopens)
-            if self._breaker_sigs.get(t) != sig:
-                self._breaker_sigs[t] = sig
-                changed = True
-        if changed:
-            try:
-                self._breaker_store.save({
-                    t: br.export_state(wallclock=self._wallclock)
-                    for t, br in self._breakers.items()
-                })
-            except Exception as e:  # noqa: BLE001 — persistence must not fail rounds
-                self._rlog.warning("breaker_save",
-                                   "breaker state save failed: %s", e)
+        """Persist target breaker state on transitions (owned by the
+        TargetSet, which also restores it for targets that reshard in)."""
+        self._tset.maybe_save_breakers(force=force)
 
     def close(self) -> None:
         self._maybe_save_breakers(force=True)
@@ -1015,8 +1317,14 @@ def main(argv: list[str] | None = None) -> int:
         prog="tpu-pod-exporter-aggregate",
         description="Scrape per-host TPU exporters; serve slice-level rollups.",
     )
-    p.add_argument("--targets", required=True,
+    p.add_argument("--targets", default="",
                    help="comma-separated host:port (or URL) exporter targets")
+    p.add_argument("--targets-file", default="",
+                   help="file with one target per line (# comments ok), "
+                        "re-read at round start whenever its mtime changes "
+                        "— target add/remove without a restart. Takes "
+                        "precedence over --targets, which then only seeds "
+                        "membership while the file is unreadable")
     p.add_argument("--port", type=int, default=9100)
     p.add_argument("--host", default="0.0.0.0")
     p.add_argument("--interval-s", type=float, default=5.0)
@@ -1108,6 +1416,8 @@ def main(argv: list[str] | None = None) -> int:
     ))
     if ns.replay_from and targets == ("-",):
         targets = fetch.targets
+    if not targets and not ns.targets_file:
+        p.error("one of --targets / --targets-file is required")
     store = SnapshotStore()
     trace_store = tracer = None
     if ns.trace == "on":
@@ -1172,6 +1482,7 @@ def main(argv: list[str] | None = None) -> int:
         tracer=tracer,
         breaker_store=breaker_store,
         shipper=shipper,
+        targets_file=ns.targets_file,
     )
     fleet = None
     if ns.fleet_query == "on":
@@ -1186,7 +1497,7 @@ def main(argv: list[str] | None = None) -> int:
             query_tracer = Tracer(trace_store, slow_poll_s=0.0,
                                   root_name="query")
         fleet = FleetQueryPlane(
-            targets,
+            agg.targets,
             timeout_s=(ns.fleet_query_timeout_s
                        if ns.fleet_query_timeout_s > 0 else ns.timeout_s),
             breakers=agg.breakers,
@@ -1195,6 +1506,9 @@ def main(argv: list[str] | None = None) -> int:
             # Cache generation = round counter: one fan-out per query per
             # round, however many dashboard panels refresh.
             generation_fn=lambda: agg.rounds,
+            # Live membership: a --targets-file reload changes agg.targets
+            # between rounds; each query snapshots the current view.
+            targets_fn=lambda: agg.targets,
         )
         agg.set_fleet(fleet)
     loop = CollectorLoop(agg, interval_s=ns.interval_s)

@@ -362,6 +362,132 @@ def apply_chaos(spec: str, seed: int, backend, attribution, scanner):
     return backend, attribution, scanner, wrappers
 
 
+# --- Leaf chaos (sharded aggregation tree) -----------------------------------
+
+
+@dataclass
+class LeafEvent:
+    """One scripted action against a leaf aggregator in the shard-demo
+    timeline: ``kill`` (SIGKILL-shaped: the leaf's HTTP server stops
+    serving and its in-flight round never becomes visible) or ``restart``
+    (a fresh leaf process on the same state dir — breaker + shard-map
+    carryover is exactly what the restart asserts)."""
+
+    action: str              # "kill" | "restart"
+    leaf: str                # leaf id as the harness registered it
+    round_idx: int           # driver round the event arms at
+    at_call: int | None = None  # kill MID-round, after this many scrapes
+    fired: bool = field(default=False, compare=False)
+
+
+LEAF_ACTIONS = ("kill", "restart")
+
+_LEAF_EVENT_RE = re.compile(
+    r"^(?P<action>[a-z]+):(?P<leaf>[^@]+)@(?P<round>\d+)(?:#(?P<call>\d+))?$"
+)
+
+
+def parse_leaf_timeline(spec: str) -> list[LeafEvent]:
+    """``--leaf-timeline`` grammar, one event per comma::
+
+        event := action ":" leaf "@" round ["#" call]
+        action := kill | restart
+
+    ``kill:1a@3#12`` kills leaf ``1a`` in driver round 3 after its 12th
+    target scrape of that round (mid-round — the crash shape the HA dedup
+    must absorb); ``restart:1a@6`` brings it back in round 6. Malformed
+    events raise ValueError loudly, same contract as parse_chaos_spec."""
+    events: list[LeafEvent] = []
+    for raw in spec.split(","):
+        raw = raw.strip()
+        if not raw:
+            continue
+        m = _LEAF_EVENT_RE.match(raw)
+        if m is None:
+            raise ValueError(
+                f"leaf timeline event {raw!r}: want action:leaf@round[#call]"
+            )
+        action = m.group("action")
+        if action not in LEAF_ACTIONS:
+            raise ValueError(
+                f"leaf timeline event {raw!r}: unknown action {action!r} "
+                f"(want one of {'/'.join(LEAF_ACTIONS)})"
+            )
+        call = m.group("call")
+        if action == "restart" and call is not None:
+            raise ValueError(
+                f"leaf timeline event {raw!r}: #call only applies to kill"
+            )
+        events.append(LeafEvent(
+            action=action,
+            leaf=m.group("leaf"),
+            round_idx=int(m.group("round")),
+            at_call=int(call) if call is not None else None,
+        ))
+    if not events:
+        raise ValueError(f"leaf timeline {spec!r} contains no events")
+    return events
+
+
+class LeafKillHook:
+    """Executes a :func:`parse_leaf_timeline` schedule against a running
+    leaf tier — the shard-demo's kill switch (``loadgen/fleet.py``).
+
+    The harness provides ``kill_fn(leaf)`` / ``restart_fn(leaf)``;
+    whole-round events fire from :meth:`begin_round` (driver thread),
+    mid-round kills fire from :meth:`on_scrape`, which the victim leaf's
+    fetch wrapper calls per target scrape — concurrently from the leaf's
+    scrape pool, hence the lock. Deterministic by construction: events
+    fire at fixed (round, call) coordinates, no randomness."""
+
+    def __init__(self, events: "list[LeafEvent]", kill_fn, restart_fn) -> None:
+        self.events = list(events)
+        self._kill_fn = kill_fn
+        self._restart_fn = restart_fn
+        self._lock = threading.Lock()
+        # (round_idx, action, leaf) per fired event — the executed
+        # timeline, asserted by the harness.
+        self.executed: list[tuple[int, str, str]] = []
+
+    def begin_round(self, round_idx: int) -> None:
+        """Fire restarts and whole-round kills armed at this round (called
+        once per driver round, before the leaves poll)."""
+        for ev in self.events:
+            if ev.fired or ev.round_idx != round_idx:
+                continue
+            if ev.action == "restart":
+                ev.fired = True
+                self.executed.append((round_idx, "restart", ev.leaf))
+                self._restart_fn(ev.leaf)
+            elif ev.action == "kill" and ev.at_call is None:
+                ev.fired = True
+                self.executed.append((round_idx, "kill", ev.leaf))
+                self._kill_fn(ev.leaf)
+
+    def on_scrape(self, leaf: str, round_idx: int, call_idx: int) -> bool:
+        """Mid-round kill check, called per target scrape from the leaf's
+        fetch path; True exactly once, when the leaf just died."""
+        with self._lock:
+            fire = None
+            for ev in self.events:
+                if (
+                    not ev.fired
+                    and ev.action == "kill"
+                    and ev.at_call is not None
+                    and ev.leaf == leaf
+                    and ev.round_idx == round_idx
+                    and call_idx >= ev.at_call
+                ):
+                    fire = ev
+                    break
+            if fire is None:
+                return False
+            fire.fired = True
+            self.executed.append((round_idx, "kill", leaf))
+        self._kill_fn(leaf)
+        return True
+
+
 # --- Chaos remote-write receiver ---------------------------------------------
 
 

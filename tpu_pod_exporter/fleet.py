@@ -142,10 +142,16 @@ class FleetQueryPlane:
         generation_fn: Callable[[], int] | None = None,
         clock: Callable[[], float] = time.monotonic,
         wallclock: Callable[[], float] = time.time,
+        targets_fn: Callable[[], Sequence[str]] | None = None,
     ) -> None:
-        if not targets:
+        if not targets and targets_fn is None:
             raise ValueError("fleet query plane needs at least one target")
-        self._targets = tuple(targets)
+        self._static_targets = tuple(targets)
+        # Live membership (the aggregator's TargetSet view): with a
+        # --targets-file or the sharded leaf tier, the target list changes
+        # between queries — each query snapshots the callable once so its
+        # fan-out, statuses and merge ordering agree within the query.
+        self._targets_fn = targets_fn
         self._timeout_s = timeout_s
         self._fetch = fetch
         # Same auto-detection as the scrape fan-out: injected 2-arg test
@@ -177,10 +183,24 @@ class FleetQueryPlane:
             schema.TPU_AGG_FLEET_QUERY_CACHE_HITS_TOTAL.name, (), 0.0)
         self._counters.inc(
             schema.TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL.name, (), 0.0)
+        # The cap alone: workers spawn lazily per pending fan-out leg, so
+        # small fleets stay small — and a plane built before a targets
+        # file exists (targets_fn membership) still fans a grown fleet
+        # out at full width instead of a boot-sized trickle.
         self._pool = ThreadPoolExecutor(
-            max_workers=min(len(self._targets), max_workers),
+            max_workers=max_workers,
             thread_name_prefix="tpu-fleet-query",
         )
+
+    def _current_targets(self) -> tuple[str, ...]:
+        """Membership snapshot for one query (live when targets_fn is
+        wired, else the construction-time tuple)."""
+        if self._targets_fn is not None:
+            try:
+                return tuple(self._targets_fn())
+            except Exception:  # noqa: BLE001 — a broken hook degrades to static
+                return self._static_targets
+        return self._static_targets
 
     # ------------------------------------------------------------- public API
 
@@ -258,11 +278,13 @@ class FleetQueryPlane:
         self._counters.inc(
             schema.TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL.name, ())
         t0 = self._clock()
+        targets = self._current_targets()
         tracer = self._tracer
         tr = tracer.start_poll() if tracer is not None else None
-        statuses, rows_by_target = self._fan_out(route, path, params, tr)
+        statuses, rows_by_target = self._fan_out(route, path, params, tr,
+                                                 targets)
         mspan = tr.span("merge") if tr is not None else None
-        merged, dup = self._merge(route, rows_by_target, statuses)
+        merged, dup = self._merge(route, rows_by_target, statuses, targets)
         partial = any(
             st["state"] in (ERROR, TIMEOUT, QUARANTINED)
             for st in statuses.values()
@@ -275,7 +297,7 @@ class FleetQueryPlane:
             "data": self._data_shape(route, merged),
             "targets": statuses,
             "fleet": {
-                "targets": len(self._targets),
+                "targets": len(targets),
                 "ok": sum(1 for s in statuses.values() if s["state"] == OK),
                 "no_data": sum(
                     1 for s in statuses.values() if s["state"] == NO_DATA),
@@ -300,7 +322,7 @@ class FleetQueryPlane:
                 tr.end_span(mspan, "ok", series=len(merged), duplicates=dup)
             tracer.finish(
                 tr, status="ok" if not partial else "err",
-                route=route, targets=len(self._targets),
+                route=route, targets=len(targets),
                 ok=env["fleet"]["ok"], partial=partial,
             )
         self._cache.put(cache_key, env)
@@ -308,7 +330,7 @@ class FleetQueryPlane:
 
     def _fan_out(
         self, route: str, path: str, params: Mapping[str, str],
-        tr: PollTrace | None,
+        tr: PollTrace | None, targets: tuple[str, ...],
     ) -> tuple[dict[str, dict], dict[str, list]]:
         span = tr.span("fanout") if tr is not None else None
         traceparent = (
@@ -320,7 +342,7 @@ class FleetQueryPlane:
         statuses: dict[str, dict] = {}
         rows_by_target: dict[str, list] = {}
         futures: dict[Future, str] = {}
-        for target in self._targets:
+        for target in targets:
             br = self._breakers.get(target) if self._breakers else None
             if br is not None and br.state != CLOSED:
                 # Quarantine is a scrape-plane fact the query plane trusts:
@@ -375,7 +397,7 @@ class FleetQueryPlane:
         if tr is not None and span is not None:
             tr.end_span(
                 span, "ok",
-                targets=len(self._targets),
+                targets=len(targets),
                 ok=sum(1 for s in statuses.values() if s["state"] == OK),
                 timeouts=len(pending),
             )
@@ -441,7 +463,7 @@ class FleetQueryPlane:
 
     def _merge(
         self, route: str, rows_by_target: Mapping[str, list],
-        statuses: dict[str, dict],
+        statuses: dict[str, dict], targets: tuple[str, ...],
     ) -> tuple[list[dict], int]:
         """Label-identity merge — the same keying ``_publish`` uses for
         chips/slices: a series is (metric, label set), whichever host it
@@ -453,9 +475,9 @@ class FleetQueryPlane:
         signal a fleet query exists to surface. Collisions are counted in
         ``duplicate_series``."""
         groups: dict[tuple, list[tuple[str, dict]]] = {}
-        # Deterministic iteration: target construction order, so output
+        # Deterministic iteration: target membership order, so output
         # ordering resolves stably round to round.
-        for target in self._targets:
+        for target in targets:
             rows = rows_by_target.get(target)
             if not rows:
                 continue
@@ -512,7 +534,7 @@ class FleetQueryPlane:
     def stats(self) -> dict:
         """Introspection payload for the aggregator's /debug/vars."""
         return {
-            "targets": len(self._targets),
+            "targets": len(self._current_targets()),
             "timeout_s": self._timeout_s,
             "cache_entries": len(self._cache),
             "cache_capacity": self._cache.entries,

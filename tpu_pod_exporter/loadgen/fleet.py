@@ -330,12 +330,705 @@ def run_demo(n_targets: int, chips: int, polls: int, interval_s: float,
         sim.close()
 
 
+# --- Sharded aggregation tree harness (make shard-demo) ----------------------
+#
+# The fleet-query demo above runs REAL per-target collectors; at 1000
+# targets that shape is all overhead and no signal. This harness keeps the
+# leaf/root tier fully real (real LeafAggregator/RootAggregator processes-
+# in-threads, real HTTP between every tier) and makes only the NODE tier
+# synthetic: one ThreadingHTTPServer serving a deterministic exposition
+# per target path, so a 1000-target fleet stands up in milliseconds and a
+# flat single-aggregator ORACLE over the same scrape set is cheap enough
+# to assert byte-level rollup equality at every checkpoint.
+
+
+class SynthTargetFarm:
+    """N synthetic node targets behind ONE HTTP server.
+
+    ``/t/<idx>/metrics`` answers a deterministic exposition for target
+    ``idx`` at the farm's current round — values are pure functions of
+    (idx, round), so every scraper (leaf A, its HA twin, the oracle) that
+    scrapes within one farm round sees identical bytes, which is what
+    makes exact root-vs-oracle comparison possible. ``tick()`` advances
+    the round (HBM grows, duty cycles shift). Targets in ``dead`` answer
+    503 — permanently-down hosts for the breaker-carryover assertions."""
+
+    def __init__(self, n_targets: int, chips: int = 2, n_slices: int = 8,
+                 host: str = "127.0.0.1", port: int = 0) -> None:
+        import http.server
+
+        self.n_targets = n_targets
+        self.chips = chips
+        self.n_slices = n_slices
+        self.round = 0
+        self.dead: set[int] = set()
+        self.allocated = n_targets  # grows via add_targets
+        farm = self
+
+        class _FarmHandler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def do_GET(self) -> None:  # noqa: N802 — stdlib API
+                parts = self.path.split("/")
+                # /t/<idx>/metrics
+                if (len(parts) == 4 and parts[1] == "t"
+                        and parts[3] == "metrics"):
+                    try:
+                        idx = int(parts[2])
+                    except ValueError:
+                        idx = -1
+                    if 0 <= idx < farm.allocated and idx not in farm.dead:
+                        body = farm.body(idx).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type",
+                                         "text/plain; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                self.send_response(503)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, fmt: str, *args) -> None:
+                pass  # 3k requests/round; access logs would drown the demo
+
+        class _FarmServer(http.server.ThreadingHTTPServer):
+            daemon_threads = True
+            request_queue_size = 256
+
+        self._httpd = _FarmServer((host, port), _FarmHandler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
+            name="tpu-synth-farm", daemon=True,
+        )
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def url(self, idx: int) -> str:
+        return f"http://127.0.0.1:{self.port}/t/{idx}/metrics"
+
+    def targets(self, n: int | None = None) -> tuple[str, ...]:
+        return tuple(self.url(i) for i in range(n or self.n_targets))
+
+    def add_targets(self, k: int) -> tuple[str, ...]:
+        """Allocate k new target indices (a scale-up churn wave)."""
+        start = self.allocated
+        self.allocated += k
+        return tuple(self.url(i) for i in range(start, self.allocated))
+
+    def tick(self) -> None:
+        self.round += 1
+
+    def body(self, idx: int) -> str:
+        """Deterministic exposition for one target at the current round.
+        Shapes every family the aggregator tier folds: per-chip presence/
+        HBM/duty/ICI, host identity with a multislice group, pod rollups."""
+        r = self.round
+        sl = idx % self.n_slices
+        host = f"host-{idx:04d}"
+        base = (
+            f'accelerator="v5p-sim",slice_name="slice-{sl}",host="{host}",'
+            f'worker_id="{idx}"'
+        )
+        pod = f"job-{idx % 31}"
+        lines: list[str] = []
+        hbm_total = float(96 * 2**30)
+        pod_hbm = 0.0
+        for c in range(self.chips):
+            cl = (f'chip_id="{c}",device_path="",{base},pod="{pod}",'
+                  f'namespace="sim",container="worker"')
+            hbm = float((idx + 1) * 2**20 + r * 65536 + c * 4096)
+            pod_hbm += hbm
+            duty = float((idx * 7 + c * 13 + r) % 100)
+            lines.append(f'tpu_chip_info{{{cl},device_kind="",coords=""}} 1')
+            lines.append(f'tpu_hbm_used_bytes{{{cl}}} {hbm:.1f}')
+            lines.append(f'tpu_hbm_total_bytes{{{cl}}} {hbm_total:.1f}')
+            lines.append(
+                f'tpu_tensorcore_duty_cycle_percent{{{cl}}} {duty:.1f}')
+            lines.append(
+                f'tpu_ici_link_bandwidth_bytes_per_second{{{cl},link="0"}} '
+                f'{float((idx + r) % 7) * 1e6:.1f}')
+        lines.append(
+            f'tpu_host_info{{{base},multislice_group="ms-{sl % 2}",'
+            f'num_slices="{(self.n_slices + 1) // 2}"}} 1')
+        lines.append(
+            f'tpu_pod_chip_count{{pod="{pod}",namespace="sim",{base}}} '
+            f'{self.chips}')
+        lines.append(
+            f'tpu_pod_hbm_used_bytes{{pod="{pod}",namespace="sim",{base}}} '
+            f'{pod_hbm:.1f}')
+        return "\n".join(lines) + "\n"
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5.0)
+
+
+class _SimLeaf:
+    """One in-process leaf: a real :class:`~tpu_pod_exporter.shard.\
+LeafAggregator` plus its own real HTTP server (the root scrapes it over
+    the wire). ``kill()`` is SIGKILL-shaped from every observer's view:
+    the HTTP port stops answering and the in-flight round is never served;
+    ``restart`` (see _ShardSim.restart) builds a FRESH leaf on the same
+    state dir and the same port."""
+
+    def __init__(self, name: str, shard_id: str, leaf_id: str, smap,
+                 targets_file: str, state_dir: str, hook,
+                 round_ref: list[int], timeout_s: float,
+                 port: int = 0) -> None:
+        from tpu_pod_exporter.aggregate import default_fetch
+        from tpu_pod_exporter.metrics import SnapshotStore
+        from tpu_pod_exporter.persist import BreakerStateFile, ShardMapFile
+        from tpu_pod_exporter.server import MetricsServer
+        from tpu_pod_exporter.shard import LeafAggregator
+
+        self.name = name
+        self.alive = True
+        self.hook = hook
+        self._round_ref = round_ref
+        self._calls = 0
+        self._lock = threading.Lock()
+        self._default_fetch = default_fetch
+        self.store = SnapshotStore()
+        self.agg = LeafAggregator(
+            shard_id, leaf_id, smap,
+            shard_map_store=ShardMapFile(f"{state_dir}/{name}-shardmap.json"),
+            targets_file=targets_file,
+            store=self.store,
+            timeout_s=timeout_s,
+            fetch=self._fetch,
+            breaker_failures=2,
+            breaker_backoff_s=30.0,  # long: quarantine must outlive the demo
+            breaker_backoff_max_s=60.0,
+            breaker_store=BreakerStateFile(
+                f"{state_dir}/{name}-breakers.json"),
+        )
+        self.server = MetricsServer(self.store, host="127.0.0.1", port=port)
+        self.server.start()
+        self.addr = f"127.0.0.1:{self.server.port}"
+
+    def _fetch(self, target: str, timeout_s: float) -> str:
+        with self._lock:
+            idx = self._calls
+            self._calls += 1
+        if self.hook is not None:
+            self.hook.on_scrape(self.name, self._round_ref[0], idx)
+        if not self.alive:
+            raise ConnectionError("leaf dead (chaos kill)")
+        return self._default_fetch(target, timeout_s)
+
+    def begin_round(self) -> None:
+        with self._lock:
+            self._calls = 0
+
+    def kill(self) -> None:
+        if self.alive:
+            self.alive = False
+            self.server.stop()
+
+    def close(self) -> None:
+        if self.alive:
+            self.server.stop()
+            self.alive = False
+        self.agg.close()
+
+    def discard(self) -> None:
+        """Tear down WITHOUT the graceful hooks: agg.close() force-saves
+        breaker state, and a SIGKILLed process gets no close() — the
+        demo's carryover assertion must prove the TRANSITION-TIME saves
+        alone, or a regression there would be masked by this very
+        harness. Only the worker threads are reaped."""
+        if self.alive:
+            self.server.stop()
+            self.alive = False
+        self.agg._pool.shutdown(wait=False)
+
+
+class _ShardSim:
+    """The whole tree, in one process: synthetic target farm, real leaf
+    tier (HA pairs, each with HTTP server + state dir), real root, plus a
+    flat single-aggregator ORACLE over the same targets file. Rounds are
+    caller-driven (the scenario timeline needs deterministic ordering);
+    leaves poll concurrently, the way independent processes would."""
+
+    def __init__(self, n_targets: int, shards: int, ha: bool,
+                 chips: int, state_root: str, timeout_s: float = 5.0) -> None:
+        import os
+
+        from tpu_pod_exporter.aggregate import SliceAggregator
+        from tpu_pod_exporter.metrics import SnapshotStore
+        from tpu_pod_exporter.persist import ShardMapFile
+        from tpu_pod_exporter.shard import (
+            RootAggregator,
+            ShardMap,
+            default_shards,
+        )
+
+        os.makedirs(state_root, exist_ok=True)
+        self.state_root = state_root
+        self.timeout_s = timeout_s
+        self.farm = SynthTargetFarm(n_targets, chips=chips)
+        self.targets_file = os.path.join(state_root, "targets.txt")
+        self.write_targets(self.farm.targets())
+        self.smap = ShardMap(default_shards(shards))
+        self.round_ref = [0]
+        self.hook = None  # set via arm_timeline before the driver runs
+        self.leaves: dict[str, _SimLeaf] = {}
+        self._leaf_meta: dict[str, tuple[str, str, int]] = {}
+        self.topology: dict[str, tuple[str, ...]] = {}
+        for si in range(shards):
+            shard_id = f"shard-{si}"
+            addrs = []
+            for suffix in ("a", "b") if ha else ("a",):
+                name = f"{si}{suffix}"
+                leaf = _SimLeaf(
+                    name, shard_id, name, self.smap, self.targets_file,
+                    state_root, None, self.round_ref, timeout_s,
+                )
+                self.leaves[name] = leaf
+                self._leaf_meta[name] = (shard_id, name, leaf.server.port)
+                addrs.append(leaf.addr)
+            self.topology[shard_id] = tuple(addrs)
+        self.root_store = SnapshotStore()
+        self.root = RootAggregator(
+            self.topology, self.root_store, timeout_s=timeout_s,
+            targets_file=self.targets_file, shard_map=self.smap,
+            shard_map_store=ShardMapFile(
+                os.path.join(state_root, "root-shardmap.json")),
+        )
+        # The correctness oracle: ONE flat aggregator over the same
+        # targets file (breakers off so it re-scrapes dead targets every
+        # round, matching what "a target is down" means to the fleet).
+        self.oracle_store = SnapshotStore()
+        self.oracle = SliceAggregator(
+            (), self.oracle_store, timeout_s=timeout_s,
+            breaker_failures=0, targets_file=self.targets_file,
+        )
+        self._pool = None
+
+    # -------------------------------------------------------------- plumbing
+
+    def write_targets(self, targets) -> None:
+        import os
+
+        tmp = self.targets_file + ".tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            f.write("\n".join(targets) + "\n")
+        os.replace(tmp, self.targets_file)
+        # mtime granularity on some filesystems is 1s; the reload check is
+        # mtime-based, and demo rounds are subsecond — force a visible bump.
+        st = os.stat(self.targets_file)
+        os.utime(self.targets_file, (st.st_atime, st.st_mtime + 2.0))
+
+    def arm_timeline(self, timeline: str) -> None:
+        from tpu_pod_exporter.chaos import LeafKillHook, parse_leaf_timeline
+
+        self.hook = LeafKillHook(
+            parse_leaf_timeline(timeline),
+            kill_fn=lambda name: self.leaves[name].kill(),
+            restart_fn=self.restart,
+        )
+        for leaf in self.leaves.values():
+            leaf.hook = self.hook
+
+    def restart(self, name: str) -> None:
+        """A fresh leaf on the same state dir AND the same port (the root's
+        topology is fixed addresses) — the restart half of the kill event."""
+        shard_id, leaf_id, port = self._leaf_meta[name]
+        old = self.leaves[name]
+        # discard(), never close(): the dead leaf must leave behind only
+        # what its transition-time saves already fsynced (see discard).
+        old.discard()
+        self.leaves[name] = _SimLeaf(
+            name, shard_id, leaf_id, self.smap, self.targets_file,
+            self.state_root, self.hook, self.round_ref, self.timeout_s,
+            port=port,
+        )
+
+    def run_round(self) -> dict:
+        """One driver round: advance the farm, fire timeline events, poll
+        every live leaf concurrently, then the root. Returns timings."""
+        from concurrent.futures import ThreadPoolExecutor
+
+        if self._pool is None:
+            self._pool = ThreadPoolExecutor(
+                max_workers=max(len(self.leaves), 1),
+                thread_name_prefix="tpu-shard-sim",
+            )
+        self.farm.tick()
+        r = self.round_ref[0]
+        if self.hook is not None:
+            self.hook.begin_round(r)
+        t0 = time.perf_counter()
+        live = [l for l in self.leaves.values() if l.alive]
+        for leaf in live:
+            leaf.begin_round()
+        list(self._pool.map(lambda l: l.agg.poll_once(), live))
+        t1 = time.perf_counter()
+        self.root.poll_once()
+        t2 = time.perf_counter()
+        self.round_ref[0] = r + 1
+        return {"leaf_tier_s": t1 - t0, "root_s": t2 - t1,
+                "full_s": t2 - t0}
+
+    def poll_leaves(self, names) -> None:
+        for name in names:
+            leaf = self.leaves[name]
+            if leaf.alive:
+                leaf.begin_round()
+                leaf.agg.poll_once()
+
+    def root_body(self) -> str:
+        return self.root_store.current().encode().decode()
+
+    def oracle_body(self) -> str:
+        self.oracle.poll_once()
+        return self.oracle_store.current().encode().decode()
+
+    def close(self) -> None:
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        for leaf in self.leaves.values():
+            leaf.close()
+        self.root.close()
+        self.oracle.close()
+        self.farm.close()
+
+
+# Rollup families the oracle comparison covers — everything emit_rollups
+# produces plus the per-target passthrough both tiers publish.
+_ORACLE_FAMILIES = (
+    "tpu_slice_hosts_reporting",
+    "tpu_slice_chip_count",
+    "tpu_slice_hbm_used_bytes",
+    "tpu_slice_hbm_total_bytes",
+    "tpu_slice_hbm_used_percent",
+    "tpu_slice_tensorcore_duty_cycle_avg_percent",
+    "tpu_slice_ici_bytes_per_second",
+    "tpu_multislice_slices_reporting",
+    "tpu_multislice_expected_slices",
+    "tpu_multislice_hosts_reporting",
+    "tpu_multislice_chip_count",
+    "tpu_multislice_hbm_used_bytes",
+    "tpu_multislice_ici_bytes_per_second",
+    "tpu_workload_chip_count",
+    "tpu_workload_hbm_used_bytes",
+    "tpu_workload_hosts",
+    "tpu_aggregator_target_up",
+)
+
+
+def _family_values(text: str, families=_ORACLE_FAMILIES) -> dict:
+    from tpu_pod_exporter.metrics.parse import parse_families
+
+    fams = parse_families(text)
+    out = {}
+    for name in families:
+        for s in fams.get(name, ()):
+            out[(name, tuple(sorted(s.labels.items())))] = s.value
+    return out
+
+
+def _compare_oracle(root_map: dict, oracle_map: dict) -> list[str]:
+    """Root-vs-flat-oracle rollup diff (empty = identical modulo float
+    summation order, hence the 1e-9 relative tolerance)."""
+    import math
+
+    problems = []
+    missing = set(oracle_map) - set(root_map)
+    extra = set(root_map) - set(oracle_map)
+    for k in sorted(missing)[:5]:
+        problems.append(f"missing from root: {k}")
+    for k in sorted(extra)[:5]:
+        problems.append(f"extra at root: {k}")
+    for k in oracle_map:
+        if k in root_map and not math.isclose(
+            root_map[k], oracle_map[k], rel_tol=1e-9, abs_tol=1e-9
+        ):
+            problems.append(
+                f"value drift {k}: root={root_map[k]!r} "
+                f"oracle={oracle_map[k]!r}")
+            if len(problems) > 8:
+                break
+    return problems
+
+
+def run_shard_demo(n_targets: int, shards: int, ha: bool, chips: int,
+                   churn: int, round_budget_s: float, stale_budget_s: float,
+                   state_root: str) -> dict:
+    """The sharded-tree acceptance scenario (``make shard-demo``):
+
+    1. prime the tree; two permanently-dead targets teach the owning
+       leaves a quarantine (breaker carryover fodder);
+    2. baseline: root rollups equal the flat single-aggregator oracle;
+    3. freshest-wins: every HA pair is staggered one farm round apart —
+       the root must publish the FRESHER half's values;
+    4. kill one HA leaf MID-ROUND (chaos LeafKillHook) → zero series
+       lost vs the pre-kill layout, values still oracle-equal, twin
+       staleness within budget;
+    5. restart the leaf on its state dir → quarantines carried over,
+       leaf_up recovers;
+    6. churn wave: remove/add ``churn`` targets via the targets file →
+       assignment moves ≤ changed + targets/shards, every tier reshards
+       live (no restarts), rollups oracle-equal again;
+    7. round-time budget over the whole run.
+    """
+    import math
+
+    from tpu_pod_exporter.metrics.parse import parse_families
+    from tpu_pod_exporter.shard import count_moves
+
+    result: dict = {
+        "ok": False, "targets": n_targets, "shards": shards, "ha": ha,
+        "chips": chips,
+    }
+    if not ha:
+        result["error"] = "shard demo needs --ha (the failover is the point)"
+        return result
+    sim = _ShardSim(n_targets, shards, ha, chips, state_root)
+    timings: list[dict] = []
+    try:
+        # Two permanently-dead targets (and their leaf quarantines).
+        sim.farm.dead = {0, 1}
+        dead_urls = [sim.farm.url(0), sim.farm.url(1)]
+        victim_shard = sim.smap.assign(dead_urls[0])
+        victim = f"{victim_shard.rsplit('-', 1)[1]}a"
+        twin = f"{victim_shard.rsplit('-', 1)[1]}b"
+        shard_size = sum(
+            1 for t in sim.farm.targets()
+            if sim.smap.assign(t) == victim_shard
+        )
+        # Rounds 0-2 prime, 3-4 are the staggered freshest-wins phase,
+        # the kill lands mid-round 5, the restart in round 7.
+        kill_round = 5
+        sim.arm_timeline(
+            f"kill:{victim}@{kill_round}#{max(shard_size // 2, 1)},"
+            f"restart:{victim}@{kill_round + 2}"
+        )
+        result["victim"] = {"leaf": victim, "twin": twin,
+                            "shard": victim_shard,
+                            "shard_targets": shard_size}
+
+        # --- rounds 0-2: prime; breakers learn the dead targets --------
+        for _ in range(3):
+            timings.append(sim.run_round())
+
+        # --- baseline: root == flat oracle ------------------------------
+        root_map = _family_values(sim.root_body())
+        oracle_map = _family_values(sim.oracle_body())
+        problems = _compare_oracle(root_map, oracle_map)
+        if problems:
+            result["error"] = f"baseline oracle mismatch: {problems[:3]}"
+            return result
+        result["baseline"] = {"rollup_series": len(root_map),
+                              "oracle_equal": True}
+        baseline_series = set(root_map)
+        quarantined = [
+            t for t, br in (sim.leaves[victim].agg.breakers or {}).items()
+            if t in dead_urls and br.state != "closed"
+        ]
+        result["baseline"]["quarantined_dead_targets"] = len(quarantined)
+
+        # --- freshest-wins: stagger every HA pair one farm round --------
+        sim.farm.tick()
+        sim.poll_leaves([n for n in sim.leaves if n.endswith("a")])
+        sim.round_ref[0] += 1
+        sim.farm.tick()
+        sim.poll_leaves([n for n in sim.leaves if n.endswith("b")])
+        sim.round_ref[0] += 1
+        sim.root.poll_once()
+        fresh_map = _family_values(sim.root_body())
+        fresh_oracle = _family_values(sim.oracle_body())
+        problems = _compare_oracle(fresh_map, fresh_oracle)
+        if problems:
+            result["error"] = (
+                f"freshest-wins violated (root served the stale HA half): "
+                f"{problems[:3]}")
+            return result
+        result["freshest_wins"] = {"oracle_equal_at_newer_round": True}
+
+        # --- kill one HA leaf mid-round ---------------------------------
+        t_kill = sim.run_round()  # the hook fires inside the victim's poll
+        timings.append(t_kill)
+        if (sim.round_ref[0] - 1, "kill", victim) not in sim.hook.executed:
+            result["error"] = (
+                f"timeline did not fire the kill: {sim.hook.executed}")
+            return result
+        body = sim.root_body()
+        kill_map = _family_values(body)
+        lost = baseline_series - set(kill_map)
+        result["kill"] = {
+            "executed": list(sim.hook.executed),
+            "series_before": len(baseline_series),
+            "series_after": len(kill_map),
+            "series_lost": sorted(lost)[:5],
+        }
+        if lost:
+            result["error"] = f"{len(lost)} series lost after leaf kill"
+            return result
+        problems = _compare_oracle(kill_map, _family_values(sim.oracle_body()))
+        if problems:
+            result["error"] = f"post-kill oracle mismatch: {problems[:3]}"
+            return result
+        fams = parse_families(body)
+        leaf_up = {
+            (s.labels["shard"], s.labels["leaf"]): s.value
+            for s in fams.get("tpu_root_leaf_up", ())
+        }
+        victim_addr = sim.leaves[victim].addr
+        twin_addr = sim.leaves[twin].addr
+        if leaf_up.get((victim_shard, victim_addr)) != 0.0:
+            result["error"] = f"victim leaf_up should be 0: {leaf_up}"
+            return result
+        if leaf_up.get((victim_shard, twin_addr)) != 1.0:
+            result["error"] = f"twin leaf_up should be 1: {leaf_up}"
+            return result
+        stale = {
+            s.labels["leaf"]: s.value
+            for s in fams.get("tpu_root_leaf_staleness_seconds", ())
+            if s.labels["shard"] == victim_shard
+        }
+        twin_stale = stale.get(twin_addr, math.inf)
+        result["kill"]["twin_staleness_s"] = round(twin_stale, 3)
+        budget = max(stale_budget_s, 2.0 * t_kill["full_s"])
+        if twin_stale > budget:
+            result["error"] = (
+                f"twin staleness {twin_stale:.2f}s exceeds one-round budget "
+                f"{budget:.2f}s")
+            return result
+
+        # one more round with the leaf down: the shard stays covered.
+        timings.append(sim.run_round())
+
+        # --- restart: state carryover -----------------------------------
+        timings.append(sim.run_round())  # restart event fires, leaf re-polls
+        if (kill_round + 2, "restart", victim) not in sim.hook.executed:
+            result["error"] = (
+                f"timeline did not fire the restart: {sim.hook.executed}")
+            return result
+        restarted = sim.leaves[victim].agg
+        carried = [
+            t for t, br in (restarted.breakers or {}).items()
+            if t in dead_urls and br.state != "closed"
+        ]
+        fams = parse_families(sim.root_body())
+        leaf_up = {
+            s.labels["leaf"]: s.value
+            for s in fams.get("tpu_root_leaf_up", ())
+            if s.labels["shard"] == victim_shard
+        }
+        result["restart"] = {
+            "dead_target_quarantines_carried": len(carried),
+            "leaf_up_after": leaf_up.get(victim_addr),
+        }
+        if len(quarantined) and not carried:
+            result["error"] = (
+                "restarted leaf re-learned its quarantines from scratch "
+                "(breaker carryover broken)")
+            return result
+        if leaf_up.get(victim_addr) != 1.0:
+            result["error"] = f"restarted leaf not up at root: {leaf_up}"
+            return result
+
+        # --- churn wave --------------------------------------------------
+        old_targets = sim.farm.targets(sim.farm.allocated)
+        old_live = tuple(
+            t for i, t in enumerate(old_targets) if i not in sim.farm.dead
+        )
+        removed = list(old_live[2:2 + churn // 2])
+        added = list(sim.farm.add_targets(churn - churn // 2))
+        new_targets = tuple(
+            t for t in old_targets if t not in removed
+        ) + tuple(added)
+        moves = count_moves(
+            sim.smap.assignments(old_targets),
+            sim.smap.assignments(new_targets),
+        )
+        bound = churn + max(len(new_targets) // shards, 1)
+        result["churn"] = {
+            "removed": len(removed), "added": len(added),
+            "assignment_moves": moves, "bound": bound,
+        }
+        if moves > bound:
+            result["error"] = (
+                f"churn wave moved {moves} assignments, bound {bound}")
+            return result
+        sim.write_targets(new_targets)
+        timings.append(sim.run_round())  # reload + reshard + re-aggregate
+        fams = parse_families(sim.root_body())
+        leaf_targets = sum(
+            s.value for s in fams.get("tpu_root_shard_targets", ())
+        )
+        result["churn"]["leaf_reported_targets"] = int(leaf_targets)
+        if int(leaf_targets) != len(new_targets):
+            result["error"] = (
+                f"leaves report {int(leaf_targets)} targets after churn, "
+                f"want {len(new_targets)}")
+            return result
+        reshard_total = sum(
+            s.value for s in fams.get("tpu_root_reshard_moves_total", ())
+        )
+        result["churn"]["root_reshard_moves_total"] = reshard_total
+        if reshard_total < moves:
+            result["error"] = (
+                f"root reshard counter {reshard_total} below the observed "
+                f"{moves} moves")
+            return result
+        problems = _compare_oracle(
+            _family_values(sim.root_body()), _family_values(sim.oracle_body())
+        )
+        if problems:
+            result["error"] = f"post-churn oracle mismatch: {problems[:3]}"
+            return result
+
+        # --- budgets ------------------------------------------------------
+        result["timings"] = {
+            "rounds": len(timings),
+            "full_max_s": round(max(t["full_s"] for t in timings), 3),
+            "full_mean_s": round(
+                sum(t["full_s"] for t in timings) / len(timings), 3),
+            "root_max_s": round(max(t["root_s"] for t in timings), 3),
+            "budget_s": round_budget_s,
+        }
+        if result["timings"]["full_max_s"] > round_budget_s:
+            result["error"] = (
+                f"round time {result['timings']['full_max_s']}s exceeds "
+                f"budget {round_budget_s}s")
+            return result
+        result["ok"] = True
+        return result
+    finally:
+        sim.close()
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpu-loadgen-fleet",
-        description="Simulated-fleet acceptance harness for the federated "
-                    "query plane (make fleet-query-demo).",
+        description="Simulated-fleet acceptance harnesses: the federated "
+                    "query plane (make fleet-query-demo) and the sharded "
+                    "HA aggregation tree (make shard-demo).",
     )
+    p.add_argument("--mode", default="query", choices=("query", "shard"),
+                   help="query = fleet-query demo (default); shard = "
+                        "sharded-tree churn/kill demo")
+    p.add_argument("--shards", type=int, default=8,
+                   help="[shard] consistent-hash shard count")
+    p.add_argument("--no-ha", dest="ha", action="store_false", default=True,
+                   help="[shard] single leaf per shard (no HA pairs)")
+    p.add_argument("--churn", type=int, default=32,
+                   help="[shard] churn-wave size (targets removed + added)")
+    p.add_argument("--round-budget-s", type=float, default=15.0,
+                   help="[shard] max full-round (leaf tier + root) wall time")
+    p.add_argument("--stale-budget-s", type=float, default=5.0,
+                   help="[shard] max HA-twin staleness after a leaf kill")
+    p.add_argument("--state-root", default="shard-demo-state",
+                   help="[shard] state dir (breaker/shard-map carryover; "
+                        "uploaded as a CI artifact on failure)")
     p.add_argument("--targets", type=int, default=64)
     p.add_argument("--chips", type=int, default=4, help="chips per host")
     p.add_argument("--polls", type=int, default=10,
@@ -352,6 +1045,35 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--no-persist", dest="persist", action="store_false",
                    default=True, help="disable per-target persistence")
     ns = p.parse_args(argv)
+
+    if ns.mode == "shard":
+        result = run_shard_demo(
+            ns.targets, ns.shards, ns.ha, ns.chips, ns.churn,
+            ns.round_budget_s, ns.stale_budget_s, ns.state_root,
+        )
+        print(json.dumps(result, indent=1))
+        try:
+            # Into the state root: CI uploads the dir on failure, and the
+            # executed timeline + per-phase verdicts ARE the forensics.
+            with open(f"{ns.state_root}/result.json", "w",
+                      encoding="utf-8") as f:
+                json.dump(result, f, indent=1)
+        except OSError:
+            pass
+        if not result["ok"]:
+            print(f"SHARD DEMO FAILED: {result.get('error')}",
+                  file=sys.stderr)
+            return 1
+        t = result["timings"]
+        print(
+            f"shard-demo OK: {ns.targets} targets / {ns.shards} shards "
+            f"(HA={'on' if ns.ha else 'off'}), mid-round leaf kill → "
+            f"0 series lost, churn {ns.churn} → "
+            f"{result['churn']['assignment_moves']} moves "
+            f"(bound {result['churn']['bound']}), round max "
+            f"{t['full_max_s']}s (budget {t['budget_s']}s)"
+        )
+        return 0
 
     result = run_demo(
         ns.targets, ns.chips, ns.polls, ns.interval_s,

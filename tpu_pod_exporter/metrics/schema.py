@@ -925,6 +925,154 @@ FLEET_QUERY_SPECS: tuple[MetricSpec, ...] = (
     TPU_AGG_FLEET_QUERY_CACHE_MISSES_TOTAL,
 )
 
+# --- Sharded aggregation tree (tpu_pod_exporter.shard) ------------------------
+# Two conditional surfaces:
+#
+#   tpu_leaf_*  — served by LEAF aggregators (a SliceAggregator owning one
+#     consistent-hash shard of node targets). These are the raw rollup
+#     ACCUMULATOR COMPONENTS (sums, sample counts, coverage flags) the root
+#     tier needs to merge partial per-shard rollups into exact fleet-wide
+#     rollups: a mean or a used/total-coverage guard cannot be recomputed
+#     from the published rollups alone, so the leaf exposes the parts.
+#     Component fields ride a `field` label rather than one spec each —
+#     they are an internal tier-to-tier contract, not operator surface.
+#
+#   tpu_root_*  — served by the ROOT aggregator that scrapes leaf
+#     expositions, dedups HA pairs per series by freshest poll wall
+#     timestamp, and re-exports the fleet-wide /metrics.
+
+# Fields carried by tpu_leaf_slice_component, in emission order. The root
+# rejects unknown fields rather than guessing (forward-compat: a newer
+# leaf's extra fields are ignored by an older root only via this list).
+LEAF_SLICE_FIELDS: tuple[str, ...] = (
+    "hosts", "chips", "hbm_used", "hbm_total", "used_n", "total_n",
+    "coverage_eq", "duty_sum", "duty_n", "ici_bw", "ici_n", "dcn_bw",
+    "dcn_n",
+)
+
+LEAF_WORKLOAD_FIELDS: tuple[str, ...] = (
+    "chips", "hbm_used", "hbm_used_n", "hosts",
+)
+
+TPU_LEAF_SLICE_COMPONENT = MetricSpec(
+    name="tpu_leaf_slice_component",
+    help="Raw slice-rollup accumulator component for this leaf's shard (see field label: sums, sample counts, and the used/total coverage-equality flag). Tier-to-tier contract consumed by the root aggregator; operators should read the tpu_slice_* rollups instead.",
+    type=GAUGE,
+    label_names=SLICE_LABELS + ("field",),
+)
+
+TPU_LEAF_WORKLOAD_COMPONENT = MetricSpec(
+    name="tpu_leaf_workload_component",
+    help="Raw workload-rollup accumulator component for this leaf's shard (see field label). Tier-to-tier contract consumed by the root aggregator.",
+    type=GAUGE,
+    label_names=WORKLOAD_LABELS + ("field",),
+)
+
+TPU_LEAF_SLICE_GROUP_INFO = MetricSpec(
+    name="tpu_leaf_slice_group_info",
+    help="Multi-slice membership observed by this leaf (slice -> group join key, from tpu_host_info); value is always 1. The root rebuilds multislice rollups fleet-wide from these.",
+    type=GAUGE,
+    label_names=SLICE_LABELS + ("multislice_group", "num_slices"),
+)
+
+TPU_LEAF_SHARD_INFO = MetricSpec(
+    name="tpu_leaf_shard_info",
+    help="Identity of this leaf aggregator: which consistent-hash shard it serves, its leaf id within the (optionally HA-paired) shard, and the ring it hashes with (num_shards/vnodes); value is always 1. The root refuses bodies whose shard OR ring disagrees with its own configuration — a leaf on a different ring covers a different target subset, and summing it would silently double-count the fleet rollups.",
+    type=GAUGE,
+    label_names=("shard", "leaf", "num_shards", "vnodes"),
+)
+
+TPU_LEAF_TARGETS = MetricSpec(
+    name="tpu_leaf_targets",
+    help="Node targets currently assigned to this leaf's shard by the consistent-hash map (tracks live resharding as targets join/leave).",
+    type=GAUGE,
+    label_names=("shard",),
+)
+
+TPU_LEAF_RESHARD_MOVES_TOTAL = MetricSpec(
+    name="tpu_leaf_reshard_moves_total",
+    help="Target assignment changes applied by this leaf since start (targets entering or leaving its shard on a targets-file reload). The root-side fleet view is tpu_root_reshard_moves_total.",
+    type=COUNTER,
+)
+
+LEAF_SPECS: tuple[MetricSpec, ...] = (
+    TPU_LEAF_SLICE_COMPONENT,
+    TPU_LEAF_WORKLOAD_COMPONENT,
+    TPU_LEAF_SLICE_GROUP_INFO,
+    TPU_LEAF_SHARD_INFO,
+    TPU_LEAF_TARGETS,
+    TPU_LEAF_RESHARD_MOVES_TOTAL,
+)
+
+TPU_ROOT_LEAF_UP = MetricSpec(
+    name="tpu_root_leaf_up",
+    help="1 if this leaf aggregator was scraped successfully in the root's last round. An HA shard is healthy while at least one of its leaves is up; TpuRootLeafDown alerts on any leaf down.",
+    type=GAUGE,
+    label_names=("shard", "leaf"),
+)
+
+TPU_ROOT_LEAF_STALENESS_SECONDS = MetricSpec(
+    name="tpu_root_leaf_staleness_seconds",
+    help="Age of this leaf's last completed round at the root's merge time (root wall clock minus the leaf's tpu_aggregator_last_round_timestamp_seconds). The freshest leaf of each HA pair wins the per-series dedup; absent while the leaf has never answered.",
+    type=GAUGE,
+    label_names=("shard", "leaf"),
+)
+
+TPU_ROOT_SHARD_TARGETS = MetricSpec(
+    name="tpu_root_shard_targets",
+    help="Node targets served under this shard per its freshest answering leaf (tpu_leaf_targets passthrough).",
+    type=GAUGE,
+    label_names=("shard",),
+)
+
+TPU_ROOT_SHARD_QUARANTINED_TARGETS = MetricSpec(
+    name="tpu_root_shard_quarantined_targets",
+    help="Node targets of this shard whose leaf-side scrape breaker is currently open or half-open (quarantined by the shard's freshest answering leaf).",
+    type=GAUGE,
+    label_names=("shard",),
+)
+
+TPU_ROOT_DEDUP_STALE_WINS_TOTAL = MetricSpec(
+    name="tpu_root_dedup_stale_wins_total",
+    help="Series groups where the HA dedup had to take a STALER leaf's value because the shard's freshest answering leaf did not carry the series (e.g. a just-restarted leaf mid-warmup). Zero in steady state; a sustained rate means an HA pair disagrees about its shard.",
+    type=COUNTER,
+)
+
+TPU_ROOT_RESHARD_MOVES_TOTAL = MetricSpec(
+    name="tpu_root_reshard_moves_total",
+    help="Target-to-shard assignment changes the root has observed across targets-file reloads since start (adds + removes + shard moves). A churn wave moves about (changed targets + targets/shards); TpuRootReshardStorm alerts on a sustained rate.",
+    type=COUNTER,
+)
+
+TPU_ROOT_LAST_ROUND_TIMESTAMP_SECONDS = MetricSpec(
+    name="tpu_root_last_round_timestamp_seconds",
+    help="Unix timestamp of the root aggregator's most recent completed merge round.",
+    type=GAUGE,
+)
+
+TPU_ROOT_ROUND_DURATION_SECONDS = MetricSpec(
+    name="tpu_root_round_duration_seconds",
+    help="Wall time of the root's last full round (scrape every leaf + merge + publish).",
+    type=GAUGE,
+)
+
+TPU_ROOT_ROUND_HIST = HistogramSpec(
+    name="tpu_root_round_seconds",
+    help="Distribution of full root merge-round durations since start. The shard-demo round-time budget reads this.",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
+ROOT_SPECS: tuple[MetricSpec, ...] = (
+    TPU_ROOT_LEAF_UP,
+    TPU_ROOT_LEAF_STALENESS_SECONDS,
+    TPU_ROOT_SHARD_TARGETS,
+    TPU_ROOT_SHARD_QUARANTINED_TARGETS,
+    TPU_ROOT_DEDUP_STALE_WINS_TOTAL,
+    TPU_ROOT_RESHARD_MOVES_TOTAL,
+    TPU_ROOT_LAST_ROUND_TIMESTAMP_SECONDS,
+    TPU_ROOT_ROUND_DURATION_SECONDS,
+)
+
 # The rollup surface the aggregator's remote-write egress ships
 # (tpu_pod_exporter.egress): the slice/multislice/workload rollups plus
 # per-target up — the "what is the fleet doing" set a central TSDB wants,

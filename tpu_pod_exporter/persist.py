@@ -1215,11 +1215,15 @@ class WalBuffer:
 # ------------------------------------------------- aggregator breaker state
 
 
-class BreakerStateFile:
-    """Tiny JSON persistence for the aggregator's per-target breakers —
-    the same crash discipline (atomic write, tolerant load) at a scale
-    where a WAL would be overkill: the state is a handful of dicts that
-    change on target transitions, not per round."""
+class _JsonStateFile:
+    """Shared skeleton for the tiny keyed-JSON state files (atomic write,
+    tolerant load, wall-stamped wrapper) — one crash discipline for every
+    subclass, at a scale where a WAL would be overkill: state that changes
+    on transitions, not per round. Subclasses set ``INNER_KEY`` (the one
+    document key under the wall stamp) and ``WHAT`` (log wording)."""
+
+    INNER_KEY = "state"
+    WHAT = "state"
 
     def __init__(self, path: str,
                  wallclock: Callable[[], float] = time.time) -> None:
@@ -1228,31 +1232,66 @@ class BreakerStateFile:
         try:
             os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         except OSError as e:
-            log.error("breaker state dir for %s unusable: %s", path, e)
+            log.error("%s dir for %s unusable: %s", self.WHAT, path, e)
 
-    def load(self) -> dict[str, dict]:
+    def _load_inner(self) -> dict:
+        """The inner document ({} when absent/corrupt — callers rebuild
+        from live inputs and the next save repairs the file)."""
         try:
             with open(self.path, encoding="utf-8") as f:
                 doc = json.load(f)
             if not isinstance(doc, dict):
                 raise TypeError("top-level value must be an object")
-            targets = doc.get("targets", {})
-            return {
-                str(k): v for k, v in targets.items() if isinstance(v, dict)
-            }
+            inner = doc.get(self.INNER_KEY, {})
+            return inner if isinstance(inner, dict) else {}
         except FileNotFoundError:
             return {}
         except Exception as e:  # noqa: BLE001 — never refuse to start
-            log.warning("breaker state %s unreadable (%s); starting with "
-                        "fresh breakers", self.path, e)
+            log.warning("%s %s unreadable (%s); rebuilding from live "
+                        "inputs", self.WHAT, self.path, e)
             return {}
 
-    def save(self, states: dict[str, dict]) -> None:
-        doc = {"wall": self._wallclock(), "targets": states}
+    def _save_inner(self, inner: dict) -> None:
+        doc = {"wall": self._wallclock(), self.INNER_KEY: inner}
         try:
             atomic_write(self.path, json.dumps(doc).encode())
         except OSError as e:
-            log.warning("breaker state save to %s failed: %s", self.path, e)
+            log.warning("%s save to %s failed: %s", self.WHAT, self.path, e)
+
+
+class BreakerStateFile(_JsonStateFile):
+    """Per-target circuit-breaker persistence for the aggregator tiers:
+    a restart keeps its quarantines instead of re-learning every dead
+    target from closed."""
+
+    INNER_KEY = "targets"
+    WHAT = "breaker state"
+
+    def load(self) -> dict[str, dict]:
+        return {
+            str(k): v for k, v in self._load_inner().items()
+            if isinstance(v, dict)
+        }
+
+    def save(self, states: dict[str, dict]) -> None:
+        self._save_inner(states)
+
+
+class ShardMapFile(_JsonStateFile):
+    """Consistent-hash shard-map persistence
+    (``tpu_pod_exporter.shard``): a restarted leaf or root resumes the
+    assignment view it last acted on, so the first refresh after a
+    restart counts real reshard moves instead of re-learning the whole
+    map as churn."""
+
+    INNER_KEY = "shard_map"
+    WHAT = "shard map"
+
+    def load(self) -> dict[str, object]:
+        return self._load_inner()
+
+    def save(self, doc: dict[str, object]) -> None:
+        self._save_inner(doc)
 
 
 # ------------------------------------------------------------ status helper

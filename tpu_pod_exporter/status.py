@@ -54,7 +54,26 @@ def main(argv=None) -> int:
                           "local backends")
     pre.add_argument("--fleet-window", type=float, default=60.0,
                      help="trailing window for the fleet view, seconds")
+    pre.add_argument("--tree", default="",
+                     help="root aggregator host:port — render the sharded "
+                          "aggregation tree topology (leaves, HA pairs, "
+                          "per-shard target counts, quarantines, freshness "
+                          "winner) from the root's /metrics")
     ns, rest = pre.parse_known_args(argv)
+    if ns.tree:
+        try:
+            if ns.watch <= 0:
+                return _run_tree(ns.tree, as_json=ns.json)
+            while True:
+                if not ns.json:
+                    print("\x1b[H\x1b[2J", end="")
+                rc = _run_tree(ns.tree,
+                               as_json="line" if ns.json else False)
+                if rc != 0:
+                    return rc
+                time.sleep(ns.watch)
+        except KeyboardInterrupt:
+            return 0
     if ns.fleet:
         try:
             if ns.watch <= 0:
@@ -211,6 +230,149 @@ def render_fleet(envelopes: dict[str, dict], window_s: float) -> str:
     out.append("")
     out.append(footer)
     return "\n".join(out)
+
+
+def fetch_tree(addr: str, timeout_s: float = 5.0) -> dict:
+    """Scrape the root aggregator's /metrics and fold the shard-topology
+    view out of it: per-shard target counts/quarantines, per-leaf up +
+    staleness (the freshest leaf of each HA pair is the dedup winner),
+    fleet rollup headlines, and the dedup/reshard counters. One HTTP GET —
+    the tree view is exactly what the root already publishes."""
+    import urllib.request
+
+    from tpu_pod_exporter.metrics import schema
+    from tpu_pod_exporter.metrics.parse import parse_families
+
+    base = addr if addr.startswith(("http://", "https://")) else f"http://{addr}"
+    with urllib.request.urlopen(f"{base}/metrics", timeout=timeout_s) as resp:  # noqa: S310 — operator-supplied address
+        text = resp.read().decode("utf-8", errors="replace")
+    fams = parse_families(text)
+
+    def first_value(name: str, default=None):
+        rows = fams.get(name)
+        return rows[0].value if rows else default
+
+    shards: dict[str, dict] = {}
+    for s in fams.get(schema.TPU_ROOT_LEAF_UP.name, ()):
+        shard = s.labels.get("shard", "?")
+        leaf = s.labels.get("leaf", "?")
+        entry = shards.setdefault(
+            shard, {"targets": None, "quarantined": None, "leaves": {}})
+        entry["leaves"][leaf] = {"up": s.value, "staleness_s": None}
+    for s in fams.get(schema.TPU_ROOT_LEAF_STALENESS_SECONDS.name, ()):
+        shard = s.labels.get("shard", "?")
+        leaf = s.labels.get("leaf", "?")
+        entry = shards.get(shard)
+        if entry and leaf in entry["leaves"]:
+            entry["leaves"][leaf]["staleness_s"] = s.value
+    for s in fams.get(schema.TPU_ROOT_SHARD_TARGETS.name, ()):
+        entry = shards.get(s.labels.get("shard", "?"))
+        if entry is not None:
+            entry["targets"] = s.value
+    for s in fams.get(schema.TPU_ROOT_SHARD_QUARANTINED_TARGETS.name, ()):
+        entry = shards.get(s.labels.get("shard", "?"))
+        if entry is not None:
+            entry["quarantined"] = s.value
+    for entry in shards.values():
+        fresh = None
+        for leaf, doc in entry["leaves"].items():
+            st = doc["staleness_s"]
+            if doc["up"] and st is not None and (
+                    fresh is None or st < entry["leaves"][fresh]["staleness_s"]):
+                fresh = leaf
+        entry["freshest"] = fresh
+    up_targets = sum(
+        1 for s in fams.get(schema.TPU_AGG_TARGET_UP.name, ())
+        if s.value == 1.0
+    )
+    return {
+        "root": addr,
+        "shards": shards,
+        "fleet": {
+            "targets": len(fams.get(schema.TPU_AGG_TARGET_UP.name, ())),
+            "targets_up": up_targets,
+            "chips": sum(
+                s.value for s in fams.get(schema.TPU_SLICE_CHIP_COUNT.name,
+                                          ())),
+            "dedup_stale_wins_total": first_value(
+                schema.TPU_ROOT_DEDUP_STALE_WINS_TOTAL.name),
+            "reshard_moves_total": first_value(
+                schema.TPU_ROOT_RESHARD_MOVES_TOTAL.name),
+            "last_round_ts": first_value(
+                schema.TPU_ROOT_LAST_ROUND_TIMESTAMP_SECONDS.name),
+            "round_duration_s": first_value(
+                schema.TPU_ROOT_ROUND_DURATION_SECONDS.name),
+        },
+    }
+
+
+def render_tree(doc: dict) -> str:
+    """Shard-topology table + fleet footer, mirroring the --fleet view."""
+    rows = []
+    for shard in sorted(doc["shards"]):
+        entry = doc["shards"][shard]
+        leaf_cells = []
+        for leaf in sorted(entry["leaves"]):
+            ldoc = entry["leaves"][leaf]
+            mark = "*" if leaf == entry.get("freshest") else ""
+            if ldoc["up"]:
+                st = ldoc["staleness_s"]
+                age = f" {st:.1f}s" if st is not None else ""
+                leaf_cells.append(f"{leaf}{mark} up{age}")
+            else:
+                leaf_cells.append(f"{leaf} DOWN")
+        t = entry.get("targets")
+        q = entry.get("quarantined")
+        rows.append([
+            shard,
+            int(t) if t is not None else "-",
+            int(q) if q is not None else "-",
+            ", ".join(leaf_cells) or "-",
+        ])
+    out = []
+    if rows:
+        out.append(render_table(
+            rows, ["shard", "targets", "quar", "leaves (* = freshest)"]))
+    else:
+        out.append("no shard topology published (is this a root aggregator?)")
+    f = doc["fleet"]
+    footer = (f"fleet: {f['targets_up']}/{f['targets']} targets up · "
+              f"{f['chips']:g} chips")
+    if f.get("dedup_stale_wins_total") is not None:
+        footer += f" · stale wins {f['dedup_stale_wins_total']:g}"
+    if f.get("reshard_moves_total") is not None:
+        footer += f" · reshard moves {f['reshard_moves_total']:g}"
+    if f.get("last_round_ts"):
+        footer += f" · round {time.time() - f['last_round_ts']:.1f}s ago"
+    down = [
+        f"{leaf} ({shard})"
+        for shard, entry in sorted(doc["shards"].items())
+        for leaf, ldoc in sorted(entry["leaves"].items())
+        if not ldoc["up"]
+    ]
+    if down:
+        footer += "\n  leaves down: " + ", ".join(down)
+    out.append("")
+    out.append(footer)
+    return "\n".join(out)
+
+
+def _run_tree(addr: str, as_json=False) -> int:
+    import json as _json
+
+    try:
+        doc = fetch_tree(addr)
+    except Exception as e:  # noqa: BLE001 — a down root is the answer
+        print(f"tree query against {addr} failed: {e}", file=sys.stderr)
+        return 1
+    if as_json:
+        print(_json.dumps(doc, indent=None if as_json == "line" else 1),
+              flush=True)
+        return 0
+    print(f"shard tree via {addr}")
+    print()
+    print(render_tree(doc))
+    return 0
 
 
 def _run_fleet(addr: str, window_s: float, as_json=False) -> int:
