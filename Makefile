@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -126,6 +126,21 @@ egress-demo:
 # (in-process, send-injected — measures shipper drain throughput).
 egress-drain-check:
 	python -m tpu_pod_exporter.egress --drain-check --outage-s 180 --budget-s 20
+
+# Fleet scenario engine (deploy/RUNBOOK.md "Partition playbook"): runs the
+# 7 named chaos timelines (symmetric/asymmetric/flapping partitions, slice
+# preemption, restart wave + hotspot, churn storm, receiver outage —
+# tpu_pod_exporter/scenario.py DSL) against the FULL simulated stack
+# (synthetic node farm → real HA leaf tier → real root → remote-write
+# egress into a ledgered chaos receiver), with invariants asserted at
+# every tick: zero acked-sample loss, bounded per-tier staleness, root
+# rollups oracle-equal outside injected windows, no series/RSS leaks, and
+# every injected fault attributable from the exposition alone.
+# Deterministic under --seed; CI runs a reduced-scale smoke and uploads
+# the state dir + per-tick scenario trace on failure.
+scenario-demo:
+	python -m tpu_pod_exporter.loadgen.scenario --targets 120 --shards 4 \
+		--state-root scenario-demo-state
 
 native:
 	$(MAKE) -C native

@@ -13,6 +13,7 @@ from tpu_pod_exporter.supervisor import (
     CLOSED,
     HALF_OPEN,
     OPEN,
+    PROBATION_SUCCESSES,
     STATE_VALUES,
     CircuitBreaker,
     SourceSkipped,
@@ -81,7 +82,7 @@ class TestCircuitBreaker:
             waits.append(br.seconds_until_probe)
         assert waits == [1.0, 2.0, 4.0, 8.0, 8.0, 8.0]
 
-    def test_success_resets_backoff(self):
+    def test_sustained_success_resets_backoff(self):
         clock = [0.0]
         br = make_breaker(clock)
         for _ in range(3):
@@ -89,11 +90,45 @@ class TestCircuitBreaker:
         clock[0] += br.seconds_until_probe
         assert br.decide() == "probe"
         br.record_success()
+        # The probe success alone is probation, not amnesty (see the
+        # flapping-partition hardening): the reopen count survives until
+        # PROBATION_SUCCESSES follow-up successes land.
+        assert br.state == CLOSED
+        assert br.reopens == 1
+        for _ in range(PROBATION_SUCCESSES):
+            br.record_success()
         assert br.reopens == 0
         for _ in range(3):
             br.record_failure()
         # A fresh incident starts over at the base backoff, not 2x.
         assert br.seconds_until_probe == pytest.approx(1.0)
+
+    def test_probe_success_into_flapping_cut_keeps_backoff_memory(self):
+        """The scenario-drill hardening: a half-open probe that succeeds
+        into a flapping partition (immediately followed by failures) must
+        resume from the retained backoff, not restart the incident at the
+        base — a flapping cut settles at the ceiling instead of probe-
+        storming at base cadence forever."""
+        clock = [0.0]
+        br = make_breaker(clock)  # base 1, max 8
+        waits = []
+        for _flap in range(5):
+            # Fail to (re-)open: 3 consecutive from closed, 1 from probe.
+            while br.state != OPEN:
+                if br.state == HALF_OPEN:
+                    br.record_failure()
+                    continue
+                br.record_failure()
+            waits.append(br.seconds_until_probe)
+            clock[0] += br.seconds_until_probe
+            assert br.decide() == "probe"
+            br.record_success()  # the flap's open window lets one through
+            assert br.state == CLOSED
+        # Monotone non-decreasing toward the ceiling: no reset-to-base.
+        assert waits == sorted(waits)
+        assert waits[-1] == pytest.approx(8.0)
+        assert waits[0] == pytest.approx(1.0)
+        assert br.reopens == 5  # the whole flap incident is one incident
 
     def test_jitter_bounds(self):
         for draw in (0.0, 0.25, 0.75, 1.0 - 1e-9):

@@ -747,6 +747,9 @@ class SliceAggregator:
         # histograms.
         self._round_hist = HistogramStore(schema.TPU_AGG_ROUND_HIST)
         self._scrape_hist = HistogramStore(schema.TPU_AGG_TARGET_SCRAPE_HIST)
+        # Last round's scrape-plane health (ok, quarantined, total), read
+        # by ready_detail() from HTTP threads — swapped atomically.
+        self._health: tuple[int, int, int] = (0, 0, 0)
         # Cap, not current membership: ThreadPoolExecutor spawns workers
         # lazily (one per pending task up to the cap), so a 2-target
         # aggregator never creates 16 threads — while a targets-file
@@ -905,6 +908,11 @@ class SliceAggregator:
                     if samples:
                         fallbacks[target] = samples
         pspan = tr.span("publish") if tr is not None else None
+        self._health = (
+            sum(1 for _t, text, _d in results if text is not None),
+            len(quarantined),
+            len(round_targets),
+        )
         self._publish(results, fallbacks=fallbacks, round_started=t0,
                       quarantined=quarantined)
         if tr is not None:
@@ -1238,6 +1246,29 @@ class SliceAggregator:
             agg = slices[key] = _SliceAgg()
         return agg
 
+    def ready_detail(self) -> dict:
+        """/readyz detail hook (``server.MetricsServer ready_detail_fn``):
+        an aggregator (or sharded leaf) whose ENTIRE scrape plane went
+        dark keeps serving its last snapshot over HTTP 200 — stale data
+        is still data — but flips ``state`` to ``degraded`` so operators
+        and rollouts can tell "healthy view" from "partition-suspected
+        view". Per-round detail is included either way."""
+        ok, quarantined, total = self._health
+        out: dict = {
+            "scrape_plane": {
+                "targets_ok": ok,
+                "quarantined": quarantined,
+                "targets": total,
+            },
+        }
+        if total and ok == 0 and self.rounds > 0:
+            out["degraded_sources"] = [
+                f"scrape-plane: 0/{total} targets reachable "
+                f"({quarantined} quarantined) — serving the last "
+                f"snapshot; node-side network partition suspected"
+            ]
+        return out
+
     def debug_vars(self) -> dict:
         """Introspection payload for /debug/vars — the aggregator twin of
         ExporterApp._debug_vars. Reads are cross-thread but safe: layout
@@ -1520,6 +1551,9 @@ def main(argv: list[str] | None = None) -> int:
         debug_addr=ns.debug_addr,
         trace=trace_store,
         fleet=fleet,
+        # Partition-aware readiness: all-targets-dark flips /readyz to
+        # state=degraded (still HTTP 200 — the stale view keeps serving).
+        ready_detail_fn=agg.ready_detail,
     )
 
     stop = threading.Event()

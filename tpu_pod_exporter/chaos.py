@@ -362,6 +362,171 @@ def apply_chaos(spec: str, seed: int, backend, attribution, scanner):
     return backend, attribution, scanner, wrappers
 
 
+# --- Network partitions (fleet scenario engine) ------------------------------
+#
+# Partitions are injected at the HTTP *fetch seam*: every tier-to-tier call
+# in the stack (leaf → node scrape, root → leaf scrape, fleet-query
+# fan-out, egress send) goes through an injectable callable, so ONE wrapper
+# composes with every tier. A cut raises the same ConnectionError a real
+# unreachable network yields — the wrapped tier cannot tell chaos from an
+# actual partition, which is the point.
+
+
+class PartitionError(ConnectionError):
+    """An injected network cut (the fetch never reached the peer)."""
+
+
+def _sel_matches(selector: str, addr: str) -> bool:
+    """``selector`` matches ``addr`` when equal, or when the selector is a
+    bare tier and the addr is an instance of it (``leaf`` matches
+    ``leaf:1a``; ``leaf:1a`` matches only itself)."""
+    return addr == selector or addr.split(":", 1)[0] == selector
+
+
+@dataclass
+class Cut:
+    """One directed edge cut. ``src``/``dst`` are tier selectors —
+    ``"root"``, ``"leaf"``, ``"leaf:1a"``, ``"node"``, ``"node:17"``,
+    ``"recv"`` — a bare tier matches every instance. ``flapping`` cuts
+    only on alternating engine rounds (deterministic: seeded phase +
+    round parity, no wall clock), so a flapping edge is open and cut on a
+    reproducible schedule."""
+
+    src: str
+    dst: str
+    flapping: bool = False
+    since_round: int = 0
+    phase: int = 0  # seeded flap phase: cut when (round - phase) is even
+
+
+class PartitionState:
+    """The fault switchboard every :class:`PartitionedFetch` /
+    :class:`PartitionedSend` consults. Thread-safe for concurrent fetch
+    threads (scrape pools, query fan-out, the egress sender); mutation
+    happens from the scenario driver between rounds.
+
+    ``round`` is the engine's logical clock: flapping cuts key their
+    open/cut alternation off it so the schedule is deterministic under a
+    fixed seed regardless of thread timing."""
+
+    def __init__(self, seed: int = 0) -> None:
+        self._lock = threading.Lock()
+        self._cuts: list[Cut] = []
+        self._rng = random.Random(f"{seed}:partition")
+        self.round = 0
+        # (round, "cut|heal", src, dst) — the injected history, for traces.
+        self.log: list[tuple[int, str, str, str]] = []
+
+    def advance(self, round_idx: int) -> None:
+        with self._lock:
+            self.round = round_idx
+
+    def cut(self, src: str, dst: str, flapping: bool = False) -> None:
+        """Cut the directed edge src→dst (selectors, see :class:`Cut`).
+        Symmetric partitions are two cuts; asymmetric ones are one."""
+        with self._lock:
+            phase = self._rng.randrange(2) if flapping else 0
+            self._cuts.append(Cut(src=src, dst=dst, flapping=flapping,
+                                  since_round=self.round, phase=phase))
+            self.log.append((self.round, "cut", src, dst))
+
+    def heal(self, src: str, dst: str) -> None:
+        """Remove every cut matching exactly (src, dst) as given."""
+        with self._lock:
+            self._cuts = [
+                c for c in self._cuts if not (c.src == src and c.dst == dst)
+            ]
+            self.log.append((self.round, "heal", src, dst))
+
+    def heal_all(self) -> None:
+        with self._lock:
+            for c in self._cuts:
+                self.log.append((self.round, "heal", c.src, c.dst))
+            self._cuts = []
+
+    def is_cut(self, src: str, dst: str) -> bool:
+        """Is the concrete edge src→dst cut right now (both are instance
+        addresses; cuts may be tier-wide selectors)?"""
+        with self._lock:
+            rnd = self.round
+            for c in self._cuts:
+                if not (_sel_matches(c.src, src) and _sel_matches(c.dst, dst)):
+                    continue
+                if c.flapping and (rnd - c.phase) % 2 != 0:
+                    continue  # the flap's open half-round
+                return True
+            return False
+
+    def active(self) -> list[tuple[str, str, bool]]:
+        """Currently-effective cuts as (src, dst, flapping) — flapping cuts
+        are listed only on their cut half-rounds."""
+        with self._lock:
+            rnd = self.round
+            return [
+                (c.src, c.dst, c.flapping)
+                for c in self._cuts
+                if not (c.flapping and (rnd - c.phase) % 2 != 0)
+            ]
+
+    def any_cuts(self) -> bool:
+        """Any cut INSTALLED (flapping ones count even on their open
+        half-round — the window is still an injected-fault window)."""
+        with self._lock:
+            return bool(self._cuts)
+
+
+class PartitionedFetch:
+    """Wrap any ``fetch(target, timeout_s[, traceparent])`` seam with a
+    partition check: when the (src, dst(target)) edge is cut the call
+    raises :class:`PartitionError` without touching the wire — exactly a
+    black-holed SYN from the caller's point of view, minus the timeout
+    burn (the drills inject hundreds of cut calls per round).
+
+    Deliberately a 2-arg callable: the aggregator tiers auto-detect
+    traceparent support by signature, and the wrapper must not promise a
+    kwarg it cannot forward to arbitrary inner fetches.
+    """
+
+    def __init__(self, net: PartitionState, src: str,
+                 dst_of, inner) -> None:
+        self._net = net
+        self.src = src
+        self._dst_of = dst_of  # target/url -> instance addr ("node:17", "leaf:1a")
+        self._inner = inner
+        self.blocked = 0
+
+    def __call__(self, target: str, timeout_s: float) -> str:
+        dst = self._dst_of(target)
+        if self._net.is_cut(self.src, dst):
+            self.blocked += 1
+            raise PartitionError(
+                f"partition: {self.src} -> {dst} is cut ({target})"
+            )
+        return self._inner(target, timeout_s)
+
+
+class PartitionedSend:
+    """The egress half of the seam: wraps an egress ``send(url, body,
+    headers, timeout_s)`` callable (``egress.RemoteWriteShipper``'s
+    injectable sender) with the same switchboard check."""
+
+    def __init__(self, net: PartitionState, src: str, dst: str,
+                 inner) -> None:
+        self._net = net
+        self.src = src
+        self.dst = dst
+        self._inner = inner
+        self.blocked = 0
+
+    def __call__(self, url: str, body: bytes, headers, timeout_s: float) -> int:
+        if self._net.is_cut(self.src, self.dst):
+            self.blocked += 1
+            raise PartitionError(
+                f"partition: {self.src} -> {self.dst} is cut ({url})"
+            )
+        return self._inner(url, body, headers, timeout_s)
+
+
 # --- Leaf chaos (sharded aggregation tree) -----------------------------------
 
 
@@ -534,6 +699,13 @@ class ChaosReceiver:
         self._duplicate_seqs: list[int] = []
         self._duplicate_samples = 0
         self._requests = 0
+        # Scenario-driven outage switch (set_outage): while True every
+        # request answers 503 WITHOUT consuming the seeded rule schedule —
+        # the outage is driven by the scenario timeline's rounds, and the
+        # probabilistic rules must keep their own deterministic call
+        # indices for when it lifts.
+        self._outage = False
+        self._outage_responses = 0
         # hold_next() choreography: park one request mid-handling and tell
         # the caller it is in flight (the demo SIGKILLs the sender there).
         self._hold_pending: threading.Event | None = None
@@ -623,7 +795,30 @@ class ChaosReceiver:
     def release_hold(self) -> None:
         self._hold_release.set()
 
+    def set_outage(self, down: bool) -> None:
+        """Receiver-side outage (the ``recv_outage`` scenario event): every
+        request answers 503 while set — the receiver process is "down",
+        which is different from a network cut (the client sees an HTTP
+        error, not a connection failure)."""
+        with self._lock:
+            self._outage = down
+
     def _handle(self, h) -> None:
+        with self._lock:
+            if self._outage:
+                self._outage_responses += 1
+                outage = True
+            else:
+                outage = False
+        if outage:
+            # Drain the body first: dropping a connection with an unread
+            # body reads as a RESET client-side, and an outage must look
+            # like a live-but-refusing receiver, not a cut wire.
+            length = int(h.headers.get("Content-Length") or 0)
+            if length:
+                h.rfile.read(length)
+            self._respond(h, 503, b"receiver outage\n")
+            return
         with self._lock:
             idx = self.calls
             self.calls += 1
@@ -723,6 +918,7 @@ class ChaosReceiver:
             return {
                 "requests": self._requests,
                 "calls": self.calls,
+                "outage_responses": self._outage_responses,
                 "injected": list(self.injected),
                 "accepted_seqs": list(self._accepted_seqs),
                 "accepted_samples": self._accepted_samples,

@@ -1157,11 +1157,24 @@ class CollectorLoop:
     is restarted ONCE; a second death marks it ``dead``, which the app's
     ``/healthz`` hook reports as an immediate 503 so kubelet restarts the
     pod promptly.
+
+    Boot is the exception to restart-once: a crash BEFORE the first
+    iteration ever completed is usually a transient boot-time wedge (the
+    device runtime still initializing while kubelet races the DaemonSet
+    up), and declaring ``dead`` after one retry turns a 2-second wedge
+    into a pod restart loop. First-poll crash loops therefore retry up to
+    ``boot_max_restarts`` times with a small exponential delay
+    (``boot_restart_backoff_s`` · 2^n) before staying down; once any
+    iteration has completed, the steady-state restart-once contract is
+    unchanged.
     """
 
     MAX_RESTARTS = 1
+    BOOT_MAX_RESTARTS = 3
 
-    def __init__(self, collector: Collector, interval_s: float = 1.0) -> None:
+    def __init__(self, collector: Collector, interval_s: float = 1.0,
+                 boot_max_restarts: int = BOOT_MAX_RESTARTS,
+                 boot_restart_backoff_s: float = 0.25) -> None:
         if interval_s <= 0:
             raise ValueError("interval must be positive")
         self._collector = collector
@@ -1172,6 +1185,12 @@ class CollectorLoop:
         self.overruns = 0
         self.restarts = 0
         self.dead = False
+        self.boot_max_restarts = boot_max_restarts
+        self.boot_restart_backoff_s = boot_restart_backoff_s
+        # Flipped after the first completed iteration (crash or not inside
+        # poll_once's own containment — "completed" means the thread
+        # survived it); selects the boot vs steady-state restart budget.
+        self.first_iteration_done = False
 
     def start(self) -> None:
         if self._thread is not None:
@@ -1195,17 +1214,42 @@ class CollectorLoop:
             with self._restart_lock:
                 if self._stop.is_set():
                     return
-                respawn = self.restarts < self.MAX_RESTARTS
+                boot = not self.first_iteration_done
+                budget = self.boot_max_restarts if boot else self.MAX_RESTARTS
+                respawn = self.restarts < budget
+                delay = 0.0
                 if respawn:
                     self.restarts += 1
-                    self._thread = self._spawn()
+                    if boot:
+                        # Exponential boot backoff: a transient device
+                        # wedge gets a beat to clear before the retry; a
+                        # deterministic crash burns the budget in ~2 s
+                        # instead of hot-looping.
+                        delay = self.boot_restart_backoff_s * (
+                            2.0 ** (self.restarts - 1)
+                        )
+                    else:
+                        self._thread = self._spawn()
                 else:
                     self.dead = True
             if respawn:
                 log.critical(
-                    "poll loop thread died unexpectedly; restarting (%d/%d)",
-                    self.restarts, self.MAX_RESTARTS, exc_info=True,
+                    "poll loop thread died unexpectedly%s; restarting "
+                    "(%d/%d)%s",
+                    " during boot (first poll never completed)" if boot
+                    else "",
+                    self.restarts, budget,
+                    f" in {delay:g}s" if delay > 0 else "",
+                    exc_info=True,
                 )
+                if delay > 0:
+                    # Outside the lock: stop() must never wait on this.
+                    if self._stop.wait(delay):
+                        return
+                    with self._restart_lock:
+                        if self._stop.is_set():
+                            return
+                        self._thread = self._spawn()
             else:
                 log.critical(
                     "poll loop died again (%d restart(s) used); staying "
@@ -1221,6 +1265,13 @@ class CollectorLoop:
                 self._collector.poll_once()
             except Exception:  # noqa: BLE001 — the loop must survive anything
                 log.exception("poll iteration failed")
+            if not self.first_iteration_done:
+                self.first_iteration_done = True
+                if self.restarts:
+                    # The boot-time wedge cleared: the steady-state budget
+                    # starts fresh (a restart used booting must not spend
+                    # the one steady-state restart).
+                    self.restarts = 0
             n += 1
             next_tick = start + n * self.interval_s
             now = time.monotonic()

@@ -363,13 +363,22 @@ class SynthTargetFarm:
         self.round = 0
         self.dead: set[int] = set()
         self.allocated = n_targets  # grows via add_targets
+        # Scenario-engine knobs (tpu_pod_exporter.loadgen.scenario):
+        # `hot` targets publish spiked duty/HBM (the hotspot(pod) event —
+        # values stay pure functions of (idx, round), so the oracle sees
+        # the same spike and rollup equality is preserved); `pod_gen`
+        # rotates every target's pod name (the label-churn half of a
+        # churn storm — workload label sets turn over wholesale).
+        self.hot: set[int] = set()
+        self.pod_gen = 0
         farm = self
 
         class _FarmHandler(http.server.BaseHTTPRequestHandler):
             protocol_version = "HTTP/1.1"
 
             def do_GET(self) -> None:  # noqa: N802 — stdlib API
-                parts = self.path.split("/")
+                path, _, query = self.path.partition("?")
+                parts = path.split("/")
                 # /t/<idx>/metrics
                 if (len(parts) == 4 and parts[1] == "t"
                         and parts[3] == "metrics"):
@@ -382,6 +391,24 @@ class SynthTargetFarm:
                         self.send_response(200)
                         self.send_header("Content-Type",
                                          "text/plain; charset=utf-8")
+                        self.send_header("Content-Length", str(len(body)))
+                        self.end_headers()
+                        self.wfile.write(body)
+                        return
+                # /t/<idx>/api/v1/<route> — a minimal node-side history
+                # answer so the federated query plane (leaf FleetQueryPlane
+                # → RootQueryPlane) can be exercised over real HTTP at
+                # fleet shape (the scenario engine's query-seam drills).
+                if (len(parts) >= 6 and parts[1] == "t" and parts[3] == "api"
+                        and parts[4] == "v1"):
+                    try:
+                        idx = int(parts[2])
+                    except ValueError:
+                        idx = -1
+                    if 0 <= idx < farm.allocated and idx not in farm.dead:
+                        body = farm.api_body(idx, parts[5], query).encode()
+                        self.send_response(200)
+                        self.send_header("Content-Type", "application/json")
                         self.send_header("Content-Length", str(len(body)))
                         self.end_headers()
                         self.wfile.write(body)
@@ -420,6 +447,15 @@ class SynthTargetFarm:
         self.allocated += k
         return tuple(self.url(i) for i in range(start, self.allocated))
 
+    def slice_targets(self, sl: int) -> tuple[int, ...]:
+        """Target indices of one slice (the preempt(slice-N) victim set)."""
+        return tuple(
+            i for i in range(self.allocated) if i % self.n_slices == sl
+        )
+
+    def pod_of(self, idx: int) -> str:
+        return f"job-{(idx + self.pod_gen) % 31}"
+
     def tick(self) -> None:
         self.round += 1
 
@@ -434,7 +470,8 @@ class SynthTargetFarm:
             f'accelerator="v5p-sim",slice_name="slice-{sl}",host="{host}",'
             f'worker_id="{idx}"'
         )
-        pod = f"job-{idx % 31}"
+        pod = self.pod_of(idx)
+        hot = idx in self.hot
         lines: list[str] = []
         hbm_total = float(96 * 2**30)
         pod_hbm = 0.0
@@ -442,8 +479,15 @@ class SynthTargetFarm:
             cl = (f'chip_id="{c}",device_path="",{base},pod="{pod}",'
                   f'namespace="sim",container="worker"')
             hbm = float((idx + 1) * 2**20 + r * 65536 + c * 4096)
+            if hot:
+                # A hotspot pod near-fills its HBM (additive, not a
+                # factor: normal values scale with idx, and a hotspot
+                # must dominate the workload rollups at ANY fleet size).
+                hbm += float(64 * 2**30)
             pod_hbm += hbm
             duty = float((idx * 7 + c * 13 + r) % 100)
+            if hot:
+                duty = 90.0 + float((idx * 7 + c * 13 + r) % 10)
             lines.append(f'tpu_chip_info{{{cl},device_kind="",coords=""}} 1')
             lines.append(f'tpu_hbm_used_bytes{{{cl}}} {hbm:.1f}')
             lines.append(f'tpu_hbm_total_bytes{{{cl}}} {hbm_total:.1f}')
@@ -463,10 +507,44 @@ class SynthTargetFarm:
             f'{pod_hbm:.1f}')
         return "\n".join(lines) + "\n"
 
+    def api_body(self, idx: int, route: str, query: str) -> str:
+        """One deterministic /api/v1 JSON answer for a target: a single
+        per-host series row in the node-local window_stats/query_range
+        shape (labels + stats + last_sample_wall_ts — the fields the
+        federated merge and its freshest-wins keying consume)."""
+        import urllib.parse
+
+        params = dict(urllib.parse.parse_qsl(query))
+        metric = params.get("metric", "tpu_hbm_used_bytes")
+        value = float((idx + 1) * 2**20 + self.round * 65536)
+        row = {
+            "metric": metric,
+            "labels": {"host": f"host-{idx:04d}",
+                       "slice_name": f"slice-{idx % self.n_slices}"},
+            "stats": {"last": value, "min": value, "max": value,
+                      "mean": value, "samples": max(self.round, 1)},
+            "last_sample_wall_ts": time.time(),
+        }
+        if route == "series":
+            return json.dumps([row])
+        return json.dumps({"status": "ok", "data": {"result": [row]}})
+
     def close(self) -> None:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5.0)
+
+
+def _node_addr_of(target: str) -> str:
+    """Farm target URL (``…/t/<idx>/metrics``) → partition-switchboard
+    address ``node:<idx>`` (chaos.PartitionState selectors)."""
+    parts = target.split("/")
+    if len(parts) >= 2 and "t" in parts:
+        try:
+            return f"node:{int(parts[parts.index('t') + 1])}"
+        except (ValueError, IndexError):
+            pass
+    return "node:?"
 
 
 class _SimLeaf:
@@ -480,8 +558,12 @@ LeafAggregator` plus its own real HTTP server (the root scrapes it over
     def __init__(self, name: str, shard_id: str, leaf_id: str, smap,
                  targets_file: str, state_dir: str, hook,
                  round_ref: list[int], timeout_s: float,
-                 port: int = 0) -> None:
+                 port: int = 0, net=None,
+                 breaker_backoff_s: float = 30.0,
+                 breaker_backoff_max_s: float = 60.0,
+                 query_plane: bool = False) -> None:
         from tpu_pod_exporter.aggregate import default_fetch
+        from tpu_pod_exporter.chaos import PartitionedFetch
         from tpu_pod_exporter.metrics import SnapshotStore
         from tpu_pod_exporter.persist import BreakerStateFile, ShardMapFile
         from tpu_pod_exporter.server import MetricsServer
@@ -495,20 +577,57 @@ LeafAggregator` plus its own real HTTP server (the root scrapes it over
         self._lock = threading.Lock()
         self._default_fetch = default_fetch
         self.store = SnapshotStore()
+        # The leaf→node scrape seam: scenario partitions are injected by
+        # wrapping the SAME fetch the leaf would use anyway (chaos.
+        # PartitionedFetch) — the aggregator cannot tell chaos from a
+        # genuinely unreachable node, which is the point.
+        fetch = self._fetch
+        if net is not None:
+            fetch = PartitionedFetch(
+                net, f"leaf:{name}", _node_addr_of, self._fetch)
         self.agg = LeafAggregator(
             shard_id, leaf_id, smap,
             shard_map_store=ShardMapFile(f"{state_dir}/{name}-shardmap.json"),
             targets_file=targets_file,
             store=self.store,
             timeout_s=timeout_s,
-            fetch=self._fetch,
+            fetch=fetch,
             breaker_failures=2,
-            breaker_backoff_s=30.0,  # long: quarantine must outlive the demo
-            breaker_backoff_max_s=60.0,
+            # Long by default: the shard-demo's quarantine must outlive the
+            # demo; the scenario engine shortens it so healed partitions
+            # re-admit their targets within the settle budget.
+            breaker_backoff_s=breaker_backoff_s,
+            breaker_backoff_max_s=breaker_backoff_max_s,
             breaker_store=BreakerStateFile(
                 f"{state_dir}/{name}-breakers.json"),
         )
-        self.server = MetricsServer(self.store, host="127.0.0.1", port=port)
+        # The leaf's federated /api/v1 plane — the fan-out seam of the
+        # two-level query path, partitioned through the SAME switchboard.
+        self.fleet = None
+        if query_plane:
+            from tpu_pod_exporter.fleet import (
+                FleetQueryPlane,
+                default_api_fetch,
+            )
+
+            api_fetch = default_api_fetch
+            if net is not None:
+                def _plain_api(url: str, timeout_s: float) -> dict:
+                    return default_api_fetch(url, timeout_s)
+
+                api_fetch = PartitionedFetch(
+                    net, f"leaf:{name}", _node_addr_of, _plain_api)
+            self.fleet = FleetQueryPlane(
+                self.agg.targets,
+                timeout_s=timeout_s,
+                fetch=api_fetch,
+                breakers=self.agg.breakers,
+                generation_fn=lambda: self.agg.rounds,
+                targets_fn=lambda: self.agg.targets,
+            )
+        self.server = MetricsServer(self.store, host="127.0.0.1", port=port,
+                                    ready_detail_fn=self.agg.ready_detail,
+                                    fleet=self.fleet)
         self.server.start()
         self.addr = f"127.0.0.1:{self.server.port}"
 
@@ -535,6 +654,8 @@ LeafAggregator` plus its own real HTTP server (the root scrapes it over
         if self.alive:
             self.server.stop()
             self.alive = False
+        if self.fleet is not None:
+            self.fleet.close()
         self.agg.close()
 
     def discard(self) -> None:
@@ -557,10 +678,17 @@ class _ShardSim:
     leaves poll concurrently, the way independent processes would."""
 
     def __init__(self, n_targets: int, shards: int, ha: bool,
-                 chips: int, state_root: str, timeout_s: float = 5.0) -> None:
+                 chips: int, state_root: str, timeout_s: float = 5.0,
+                 net=None, stale_serve_s: float = 0.0,
+                 leaf_breaker_backoff_s: float = 30.0,
+                 leaf_breaker_backoff_max_s: float = 60.0,
+                 root_breaker_backoff_s: float = 10.0,
+                 root_breaker_backoff_max_s: float = 120.0,
+                 n_slices: int = 8, query_plane: bool = False) -> None:
         import os
 
-        from tpu_pod_exporter.aggregate import SliceAggregator
+        from tpu_pod_exporter.aggregate import SliceAggregator, default_fetch
+        from tpu_pod_exporter.chaos import PartitionedFetch
         from tpu_pod_exporter.metrics import SnapshotStore
         from tpu_pod_exporter.persist import ShardMapFile
         from tpu_pod_exporter.shard import (
@@ -572,7 +700,9 @@ class _ShardSim:
         os.makedirs(state_root, exist_ok=True)
         self.state_root = state_root
         self.timeout_s = timeout_s
-        self.farm = SynthTargetFarm(n_targets, chips=chips)
+        self.net = net
+        self.farm = SynthTargetFarm(n_targets, chips=chips,
+                                    n_slices=n_slices)
         self.targets_file = os.path.join(state_root, "targets.txt")
         self.write_targets(self.farm.targets())
         self.smap = ShardMap(default_shards(shards))
@@ -580,6 +710,12 @@ class _ShardSim:
         self.hook = None  # set via arm_timeline before the driver runs
         self.leaves: dict[str, _SimLeaf] = {}
         self._leaf_meta: dict[str, tuple[str, str, int]] = {}
+        self._leaf_kw = {
+            "net": net,
+            "breaker_backoff_s": leaf_breaker_backoff_s,
+            "breaker_backoff_max_s": leaf_breaker_backoff_max_s,
+            "query_plane": query_plane,
+        }
         self.topology: dict[str, tuple[str, ...]] = {}
         for si in range(shards):
             shard_id = f"shard-{si}"
@@ -589,17 +725,34 @@ class _ShardSim:
                 leaf = _SimLeaf(
                     name, shard_id, name, self.smap, self.targets_file,
                     state_root, None, self.round_ref, timeout_s,
+                    **self._leaf_kw,
                 )
                 self.leaves[name] = leaf
                 self._leaf_meta[name] = (shard_id, name, leaf.server.port)
                 addrs.append(leaf.addr)
             self.topology[shard_id] = tuple(addrs)
         self.root_store = SnapshotStore()
+        # The root→leaf scrape seam, same PartitionedFetch wrapper as the
+        # leaf→node seam (addresses are fixed, so addr→leaf is a dict).
+        self.leaf_addr_of = {
+            leaf.addr: f"leaf:{name}" for name, leaf in self.leaves.items()
+        }
+        root_fetch = default_fetch
+        if net is not None:
+            root_fetch = PartitionedFetch(
+                net, "root",
+                lambda t: self.leaf_addr_of.get(t, "leaf:?"),
+                default_fetch,
+            )
         self.root = RootAggregator(
             self.topology, self.root_store, timeout_s=timeout_s,
+            fetch=root_fetch,
             targets_file=self.targets_file, shard_map=self.smap,
             shard_map_store=ShardMapFile(
                 os.path.join(state_root, "root-shardmap.json")),
+            breaker_backoff_s=root_breaker_backoff_s,
+            breaker_backoff_max_s=root_breaker_backoff_max_s,
+            stale_serve_s=stale_serve_s,
         )
         # The correctness oracle: ONE flat aggregator over the same
         # targets file (breakers off so it re-scrapes dead targets every
@@ -647,7 +800,7 @@ class _ShardSim:
         self.leaves[name] = _SimLeaf(
             name, shard_id, leaf_id, self.smap, self.targets_file,
             self.state_root, self.hook, self.round_ref, self.timeout_s,
-            port=port,
+            port=port, **self._leaf_kw,
         )
 
     def run_round(self) -> dict:
@@ -662,6 +815,9 @@ class _ShardSim:
             )
         self.farm.tick()
         r = self.round_ref[0]
+        if self.net is not None:
+            # Flapping cuts key off the driver round (chaos.Cut).
+            self.net.advance(r)
         if self.hook is not None:
             self.hook.begin_round(r)
         t0 = time.perf_counter()

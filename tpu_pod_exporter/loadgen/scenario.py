@@ -1,0 +1,805 @@
+"""Fleet scenario engine — invariant-checked end-to-end drills.
+
+``python -m tpu_pod_exporter.loadgen.scenario`` (``make scenario-demo``) is
+the acceptance harness the ROADMAP names for everything built since PR 6:
+it stands up the FULL simulated stack —
+
+    SynthTargetFarm (node tier, real HTTP)
+      → real LeafAggregator HA pairs (per-shard breakers, state dirs)
+        → real RootAggregator (+ /readyz HTTP server, RootQueryPlane)
+          → RemoteWriteShipper egress → ChaosReceiver (exactly-once ledger)
+
+— and drives the named scenario timelines from
+:mod:`tpu_pod_exporter.scenario` against it, with **invariants asserted at
+every tick**, not just at checkpoints:
+
+1. **zero acked-sample loss through egress** — the receiver's ledger must
+   end contiguous and duplicate-free for every batch the shipper framed;
+2. **bounded staleness per tier** — reachable leaves stay fresh; stale-
+   served leaves age monotonically within the --stale-serve-s budget;
+3. **root == oracle** rollup equality (flat single-aggregator oracle over
+   the same targets file) on every quiet round outside injected windows;
+4. **no series/RSS leaks** — the exposition returns to exactly the
+   expected series set after churn, and RSS growth stays bounded;
+5. **exposition-attributable faults** — every injected fault must be
+   readable from the root's exposition alone: partitioned leaves show
+   ``leaf_up 0`` + ``stale_served 1`` (+ ``partition_suspected 1`` when
+   the HA twin still answers), preempted/restarting targets show
+   ``target_up 0``, hotspots dominate the workload rollups, receiver
+   outages open the egress breaker with a visible backlog.
+
+Partitions are injected at the HTTP fetch seam via
+``chaos.PartitionState``/``PartitionedFetch``/``PartitionedSend`` — the
+same wrapper composes over leaf scrape, root scrape, the two-level query
+fan-out, and egress send — so asymmetric and flapping cuts exercise every
+tier with one mechanism. Deterministic under ``--seed``: event rounds are
+fixed by the DSL, flap phases are seeded, and farm telemetry is a pure
+function of (target, round).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+import urllib.request
+
+from tpu_pod_exporter import utils as _utils
+from tpu_pod_exporter.chaos import (
+    ChaosReceiver,
+    PartitionState,
+    PartitionedFetch,
+    PartitionedSend,
+)
+from tpu_pod_exporter.loadgen.fleet import (
+    _ShardSim,
+    _compare_oracle,
+    _family_values,
+)
+from tpu_pod_exporter.metrics import schema
+from tpu_pod_exporter.metrics.parse import parse_families
+from tpu_pod_exporter.scenario import (
+    DEFAULT_SCENARIO_ORDER,
+    SCENARIOS,
+    Scenario,
+    ScenarioEvent,
+    total_rounds,
+)
+
+# Wall-clock staleness slack for "fresh" tiers: the drills run subsecond
+# rounds, so anything beyond this means a tier silently stopped merging.
+FRESH_STALENESS_BUDGET_S = 8.0
+
+
+def _get_json(url: str, timeout_s: float = 5.0) -> dict:
+    with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — loopback harness
+        return json.loads(resp.read())
+
+
+class _Run:
+    """One scenario against one freshly-built stack."""
+
+    def __init__(self, scn: Scenario, n_targets: int, shards: int,
+                 chips: int, state_root: str, seed: int,
+                 stale_serve_s: float = 30.0) -> None:
+        from tpu_pod_exporter.egress import (
+            RemoteWriteShipper,
+            aggregator_egress_metrics,
+            build_breaker,
+            default_send,
+        )
+        from tpu_pod_exporter.server import MetricsServer
+        from tpu_pod_exporter.shard import RootQueryPlane
+
+        self.scn = scn
+        self.events = scn.events()
+        self.rounds = total_rounds(self.events, scn.settle_rounds)
+        self.state_root = state_root
+        os.makedirs(state_root, exist_ok=True)
+        self.net = PartitionState(seed=seed)
+        self.stale_serve_s = stale_serve_s
+        # Breaker backoffs scaled to subsecond drill rounds (production
+        # defaults are tens of seconds): a healed partition's quarantined
+        # targets must be re-admitted within the settle budget — the
+        # quarantine-vs-partition disambiguation half of the drill.
+        self.sim = _ShardSim(
+            n_targets, shards, True, chips, state_root,
+            timeout_s=3.0, net=self.net, stale_serve_s=stale_serve_s,
+            leaf_breaker_backoff_s=0.4, leaf_breaker_backoff_max_s=0.8,
+            root_breaker_backoff_s=0.4, root_breaker_backoff_max_s=0.8,
+            n_slices=4, query_plane=True,
+        )
+        self.membership: list[str] = list(self.sim.farm.targets())
+        # Root /readyz over real HTTP: partition-aware degradation is an
+        # operator contract, so it is asserted through the wire.
+        self.root_server = MetricsServer(
+            self.sim.root_store, host="127.0.0.1", port=0,
+            ready_detail_fn=self.sim.root.ready_detail,
+        )
+        self.root_server.start()
+        # Two-level query plane, partitioned at the root→leaf seam.
+        port_to_leaf = dict(self.sim.leaf_addr_of)
+
+        def _leaf_of_url(url: str) -> str:
+            try:
+                hostport = url.split("/", 3)[2]
+            except IndexError:
+                hostport = ""
+            return port_to_leaf.get(hostport, "leaf:?")
+
+        from tpu_pod_exporter.fleet import default_api_fetch
+
+        def _plain_api(url: str, timeout_s: float) -> dict:
+            return default_api_fetch(url, timeout_s)
+
+        self.plane = RootQueryPlane(
+            self.sim.topology, timeout_s=2.5,
+            fetch=PartitionedFetch(self.net, "root", _leaf_of_url,
+                                   _plain_api),
+            leaf_breakers=self.sim.root._breakers,
+        )
+        # Egress: the root's rollups ship to a ChaosReceiver through a
+        # partitionable sender; the ledger is the zero-loss oracle.
+        self.receiver = None
+        self.shipper = None
+        if scn.uses_egress:
+            self.receiver = ChaosReceiver([], seed=seed)
+            self.receiver.start()
+            self.shipper = RemoteWriteShipper(
+                self.receiver.url,
+                os.path.join(state_root, "egress"),
+                metrics=aggregator_egress_metrics(),
+                interval_s=0.0,
+                timeout_s=2.0,
+                breaker=build_breaker(2, 0.3, 1.5),
+                extra_labels={"host": "scenario-root"},
+                send=PartitionedSend(self.net, "root", "recv", default_send),
+            )
+            self.shipper.load()
+            self.shipper.start()
+        self.baseline_series: set | None = None
+        self.baseline_workloads = 0
+        self.rss_baseline: float | None = None
+        # Targets healed from an injected outage but possibly still
+        # quarantined leaf-side; they must come back before the run ends.
+        self.recovering: set[str] = set()
+        # Same for leaves after a root-leaf cut heals: the root's leaf
+        # breaker holds its quarantine until the next half-open probe —
+        # bounded by the settle loop, not an instant flip.
+        self.recovering_leaves: set[str] = set()
+        self.restart_batches: dict[int, tuple[int, ...]] = {}
+        self.trace: list[dict] = []
+        self.problems: list[str] = []
+
+    # ------------------------------------------------------------ event hooks
+
+    def _leaf_cut_edges(self, ev: ScenarioEvent) -> list[tuple[str, str]]:
+        pair = frozenset(ev.edge or ())
+        if pair == frozenset({"leaf", "root"}):
+            if ev.mode == "asymmetric":
+                return [("root", f"leaf:{name}")
+                        for name in self.sim.leaves if name.endswith("a")]
+            return [("root", "leaf")]
+        if pair == frozenset({"node", "leaf"}):
+            if ev.mode == "asymmetric":
+                return [(f"leaf:{name}", "node")
+                        for name in self.sim.leaves if name.endswith("a")]
+            return [("leaf", "node")]
+        return [("root", "recv")]
+
+    def _member_indices(self) -> set[int]:
+        return {self._idx_of(t) for t in self.membership}
+
+    @staticmethod
+    def _idx_of(target: str) -> int:
+        try:
+            parts = target.split("/")
+            return int(parts[parts.index("t") + 1])
+        except (ValueError, IndexError):
+            return -1
+
+    def _start_event(self, ev: ScenarioEvent) -> None:
+        farm = self.sim.farm
+        if ev.kind == "partition":
+            for src, dst in self._leaf_cut_edges(ev):
+                self.net.cut(src, dst, flapping=ev.mode == "flapping")
+        elif ev.kind == "preempt":
+            sl = int(ev.subject.rsplit("-", 1)[1])
+            victims = [i for i in farm.slice_targets(sl)
+                       if i in self._member_indices()]
+            ev_state = set(victims)
+            farm.dead |= ev_state
+            self._preempt_victims = ev_state
+        elif ev.kind == "hotspot":
+            # Resolved against the pod mapping at window start; the DSL's
+            # overlap rule keeps a concurrent churn_storm (which rotates
+            # pod names) out of the same timeline only by convention —
+            # composing them would need per-tick re-resolution here.
+            farm.hot = {
+                i for i in self._member_indices()
+                if farm.pod_of(i) == ev.subject
+            }
+        elif ev.kind == "restart_wave":
+            live = sorted(
+                i for i in self._member_indices() if i not in farm.dead
+            )[:ev.count]
+            self.restart_batches = {
+                ev.at_round + j: tuple(live[j * ev.stagger:(j + 1) * ev.stagger])
+                for j in range(ev.duration)
+            }
+        elif ev.kind == "recv_outage" and self.receiver is not None:
+            self.receiver.set_outage(True)
+
+    def _end_event(self, ev: ScenarioEvent) -> None:
+        farm = self.sim.farm
+        if ev.kind == "partition":
+            for src, dst in self._leaf_cut_edges(ev):
+                self.net.heal(src, dst)
+                if src == "root" and dst.startswith("leaf"):
+                    if dst == "leaf":
+                        self.recovering_leaves.update(self.sim.leaves)
+                    else:
+                        self.recovering_leaves.add(dst.split(":", 1)[1])
+        elif ev.kind == "preempt":
+            victims = getattr(self, "_preempt_victims", set())
+            farm.dead -= victims
+            self.recovering |= {farm.url(i) for i in victims}
+        elif ev.kind == "restart_wave":
+            # The final batch's hosts come back when the window closes
+            # (earlier batches revive on the next wave tick).
+            last = set(self.restart_batches.get(ev.end_round - 1, ()))
+            farm.dead -= last
+            self.recovering |= {farm.url(i) for i in last}
+        elif ev.kind == "hotspot":
+            farm.hot = set()
+        elif ev.kind == "recv_outage" and self.receiver is not None:
+            self.receiver.set_outage(False)
+
+    def _tick_event(self, ev: ScenarioEvent, r: int) -> None:
+        """Per-round continuation for windowed events."""
+        farm = self.sim.farm
+        if ev.kind == "restart_wave":
+            batch = self.restart_batches.get(r, ())
+            prev = self.restart_batches.get(r - 1, ())
+            farm.dead -= set(prev)
+            self.recovering |= {farm.url(i) for i in prev}
+            farm.dead |= set(batch)
+        elif ev.kind == "churn_storm":
+            k = ev.count // 2
+            added = list(farm.add_targets(ev.count - k))
+            self.membership = self.membership[k:] + added
+            farm.pod_gen += 1  # the label-churn half of the storm
+            self.sim.write_targets(self.membership)
+
+    # -------------------------------------------------------------- the drive
+
+    def run(self) -> dict:
+        result: dict = {"scenario": self.scn.name,
+                        "timeline": self.scn.timeline, "ok": False}
+        try:
+            for r in range(self.rounds):
+                for ev in self.events:
+                    if ev.end_round == r:
+                        self._end_event(ev)
+                for ev in self.events:
+                    if ev.at_round == r:
+                        self._start_event(ev)
+                for ev in self.events:
+                    if ev.at_round <= r < ev.end_round:
+                        self._tick_event(ev, r)
+                self.sim.run_round()
+                if self.shipper is not None:
+                    self.shipper.on_snapshot(self.sim.root_store.current())
+                self._check_tick(r)
+                if self.problems:
+                    result["failed_round"] = r
+                    result["problems"] = self.problems[:8]
+                    return result
+            ok = self._finish(result)
+            result["ok"] = ok and not self.problems
+            if self.problems:
+                result["problems"] = self.problems[:8]
+            return result
+        finally:
+            result["trace_ticks"] = len(self.trace)
+            self._close()
+
+    # ---------------------------------------------------------- tick checks
+
+    def _active(self, r: int) -> list[ScenarioEvent]:
+        return [ev for ev in self.events if ev.at_round <= r < ev.end_round]
+
+    def _expected_cut_leaves(self) -> set[str]:
+        """Leaf names the root cannot reach under the currently-EFFECTIVE
+        cuts (flapping cuts only on their cut half-rounds)."""
+        out: set[str] = set()
+        for src, dst, _flap in self.net.active():
+            if src != "root":
+                continue
+            if dst == "leaf":
+                out.update(self.sim.leaves)
+            elif dst.startswith("leaf:"):
+                out.add(dst.split(":", 1)[1])
+        return out
+
+    def _check_tick(self, r: int) -> None:
+        farm = self.sim.farm
+        active = self._active(r)
+        body = self.sim.root_body()
+        fams = parse_families(body)
+        series = set(_family_values(body))
+        problems: list[str] = []
+
+        leaf_up = {
+            (s.labels["shard"], s.labels["leaf"]): s.value
+            for s in fams.get(schema.TPU_ROOT_LEAF_UP.name, ())
+        }
+        stale_served = {
+            (s.labels["shard"], s.labels["leaf"]): s.value
+            for s in fams.get(schema.TPU_ROOT_LEAF_STALE_SERVED.name, ())
+        }
+        suspected = {
+            (s.labels["shard"], s.labels["leaf"]): s.value
+            for s in fams.get(
+                schema.TPU_ROOT_LEAF_PARTITION_SUSPECTED.name, ())
+        }
+        staleness = {
+            (s.labels["shard"], s.labels["leaf"]): s.value
+            for s in fams.get(
+                schema.TPU_ROOT_LEAF_STALENESS_SECONDS.name, ())
+        }
+        target_up = {
+            s.labels["target"]: s.value
+            for s in fams.get(schema.TPU_AGG_TARGET_UP.name, ())
+        }
+
+        # --- (5) attributability: injected leaf-tier cuts ----------------
+        cut_leaves = self._expected_cut_leaves()
+        for name, leaf in self.sim.leaves.items():
+            shard = self.sim._leaf_meta[name][0]
+            key = (shard, leaf.addr)
+            if leaf_up.get(key) == 1.0:
+                self.recovering_leaves.discard(name)
+            if name in cut_leaves:
+                if leaf_up.get(key) != 0.0:
+                    problems.append(
+                        f"r{r}: cut leaf {name} not attributable "
+                        f"(leaf_up={leaf_up.get(key)})")
+                if self.stale_serve_s > 0 and stale_served.get(key) != 1.0:
+                    problems.append(
+                        f"r{r}: cut leaf {name} not stale-served")
+                twin_reachable = any(
+                    n != name and n not in cut_leaves
+                    for n in self.sim.leaves
+                    if self.sim._leaf_meta[n][0] == shard
+                )
+                if twin_reachable and suspected.get(key) != 1.0:
+                    problems.append(
+                        f"r{r}: cut leaf {name} (twin reachable) not "
+                        f"marked partition-suspected")
+            elif (r >= 1 and leaf_up.get(key) != 1.0
+                    and name not in self.recovering_leaves):
+                problems.append(
+                    f"r{r}: healthy leaf {name} reported down "
+                    f"(leaf_up={leaf_up.get(key)})")
+
+        # --- (5) attributability: injected target outages ----------------
+        injected_down = {
+            farm.url(i) for i in farm.dead if i in self._member_indices()
+        }
+        for t in injected_down:
+            if target_up.get(t) != 0.0:
+                problems.append(
+                    f"r{r}: injected-down target {t} not attributable "
+                    f"(up={target_up.get(t)})")
+        reported_down = {t for t, v in target_up.items() if v == 0.0}
+        unexplained = reported_down - injected_down - self.recovering
+        if unexplained and not cut_leaves and not any(
+                ev.kind == "partition" for ev in active):
+            problems.append(
+                f"r{r}: {len(unexplained)} target(s) down without an "
+                f"injected fault: {sorted(unexplained)[:3]}")
+        self.recovering -= {t for t in self.recovering
+                            if target_up.get(t) == 1.0}
+        restart_active = [ev for ev in active if ev.kind == "restart_wave"]
+        if restart_active:
+            ev = restart_active[0]
+            batch = set(self.restart_batches.get(r, ()))
+            if len(reported_down) > 2 * ev.stagger:
+                problems.append(
+                    f"r{r}: restart wave (stagger {ev.stagger}) has "
+                    f"{len(reported_down)} targets down at once")
+            stray = {self._idx_of(t) for t in reported_down} - batch - {
+                self._idx_of(t) for t in self.recovering}
+            if stray:
+                problems.append(
+                    f"r{r}: restart wave touched targets outside its "
+                    f"batch: {sorted(stray)[:4]}")
+
+        # --- (5) attributability: hotspot dominates the workload rollups -
+        for ev in active:
+            if ev.kind != "hotspot" or not (farm.hot - farm.dead):
+                # All hot hosts are down this round (a composed restart
+                # wave can take the hot pod's only host with it): the pod
+                # is legitimately absent from the rollups.
+                continue
+            per_pod: dict[str, float] = {}
+            for s in fams.get(schema.TPU_WORKLOAD_HBM_USED_BYTES.name, ()):
+                pod = s.labels.get("pod", "?")
+                per_pod[pod] = per_pod.get(pod, 0.0) + s.value
+            hot = per_pod.get(ev.subject, 0.0)
+            others = [v for p, v in per_pod.items() if p != ev.subject]
+            if not others or hot <= 2.0 * max(others):
+                problems.append(
+                    f"r{r}: hotspot {ev.subject} not attributable from "
+                    f"workload rollups (hot={hot:g}, "
+                    f"max other={max(others) if others else 0:g})")
+
+        # --- (2) bounded staleness per tier ------------------------------
+        for key, up in leaf_up.items():
+            st = staleness.get(key)
+            if up == 1.0 and st is not None and st > FRESH_STALENESS_BUDGET_S:
+                problems.append(
+                    f"r{r}: reachable leaf {key} staleness {st:.1f}s "
+                    f"exceeds {FRESH_STALENESS_BUDGET_S:g}s")
+            if stale_served.get(key) == 1.0 and st is not None and (
+                    st > self.stale_serve_s + FRESH_STALENESS_BUDGET_S):
+                problems.append(
+                    f"r{r}: stale-served leaf {key} staleness {st:.1f}s "
+                    f"beyond the stale-serve budget")
+
+        # --- (3)+(4) series retention / oracle equality ------------------
+        partition_active = any(ev.kind == "partition" for ev in active)
+        if partition_active and self.baseline_series is not None:
+            lost = self.baseline_series - series
+            if lost:
+                problems.append(
+                    f"r{r}: {len(lost)} series lost during partition: "
+                    f"{sorted(lost)[:3]}")
+        quiet = (
+            not active
+            and not self.net.any_cuts()
+            and not farm.dead
+            and not self.recovering
+            and not self.recovering_leaves
+            and r >= 2
+        )
+        if quiet and not reported_down:
+            oracle_problems = _compare_oracle(
+                _family_values(body), _family_values(self.sim.oracle_body())
+            )
+            if oracle_problems:
+                problems.append(
+                    f"r{r}: quiet round diverged from oracle: "
+                    f"{oracle_problems[:2]}")
+            else:
+                self.baseline_series = series
+                self.baseline_workloads = len(
+                    fams.get(schema.TPU_WORKLOAD_HBM_USED_BYTES.name, ()))
+                if self.rss_baseline is None:
+                    self.rss_baseline = _utils.process_rss_bytes() or 0.0
+
+        # --- scenario-specific spot checks -------------------------------
+        if self.scn.name == "partition_symmetric" and any(
+                ev.kind == "partition" and ev.end_round - 1 == r
+                for ev in self.events):
+            # Last cut round: /readyz over the wire must say degraded
+            # while the stale view keeps serving (HTTP 200 either way).
+            doc = _get_json(
+                f"http://127.0.0.1:{self.root_server.port}/readyz")
+            if doc.get("state") != "degraded":
+                problems.append(
+                    f"r{r}: /readyz state {doc.get('state')!r} during a "
+                    f"total root-leaf partition (want degraded)")
+        if self.scn.name == "partition_asymmetric" and cut_leaves and (
+                r == max(ev.at_round for ev in self.events) + 1):
+            env = self.plane.window_stats("tpu_hbm_used_bytes",
+                                          window_s=60.0)
+            rows = env["data"]["result"]
+            if env["partial"]:
+                problems.append(
+                    f"r{r}: two-level query PARTIAL during asymmetric cut "
+                    f"(twins should cover): {env['fleet']}")
+            elif len(rows) != len(self.membership):
+                problems.append(
+                    f"r{r}: two-level query merged {len(rows)} rows, want "
+                    f"{len(self.membership)}")
+        if self.scn.name == "recv_outage" and any(
+                ev.kind == "recv_outage" and ev.end_round - 1 == r
+                for ev in self.events):
+            if not self._await_egress_wedged():
+                problems.append(
+                    f"r{r}: receiver outage not attributable from the "
+                    f"egress exposition (breaker never opened / no "
+                    f"backlog)")
+
+        self.problems.extend(problems)
+        self.trace.append({
+            "round": r,
+            "active": [ev.raw for ev in active],
+            "cuts": [list(c) for c in self.net.active()],
+            "leaf_down": sorted(
+                leaf for (_s, leaf), v in leaf_up.items() if v == 0.0),
+            "targets_down": len(reported_down),
+            "stale_served": sorted(
+                leaf for (_s, leaf), v in stale_served.items() if v == 1.0),
+            "series": len(series),
+            "problems": problems,
+        })
+
+    def _egress_exposition(self) -> dict[str, float]:
+        """The shipper's self-metric surface AS EXPOSITION (the same
+        bytes app.py would publish) — fault attribution reads metrics,
+        not private state."""
+        from tpu_pod_exporter.metrics import SnapshotBuilder
+
+        b = SnapshotBuilder()
+        self.shipper.emit(b)
+        text = b.build(timestamp=time.time()).encode().decode()
+        out: dict[str, float] = {}
+        for fam in parse_families(text).values():
+            for s in fam:
+                if not s.labels:
+                    out[s.name] = s.value
+        return out
+
+    def _await_egress_wedged(self, timeout_s: float = 8.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            exp = self._egress_exposition()
+            if (exp.get("tpu_exporter_egress_breaker_state", 0.0) != 0.0
+                    and exp.get("tpu_exporter_egress_backlog_batches",
+                                0.0) >= 1.0):
+                return True
+            # Keep FRESH batches flowing so the sender keeps probing the
+            # outage (a re-pushed identical snapshot would re-frame the
+            # same sample timestamps and corrupt the exactly-once ledger
+            # the final check reads).
+            self.sim.run_round()
+            self.shipper.on_snapshot(self.sim.root_store.current())
+            time.sleep(0.2)
+        return False
+
+    # ------------------------------------------------------------- the finish
+
+    def _finish(self, result: dict) -> bool:
+        # Settle: every injected fault healed; quarantined targets must be
+        # re-admitted (half-open probes) and the tree must converge back
+        # to oracle-equal — bounded, not open-ended.
+        deadline = time.monotonic() + 15.0
+        recovered = False
+        while time.monotonic() < deadline:
+            self.sim.run_round()
+            if self.shipper is not None:
+                self.shipper.on_snapshot(self.sim.root_store.current())
+            body = self.sim.root_body()
+            fams = parse_families(body)
+            target_up = {
+                s.labels["target"]: s.value
+                for s in fams.get(schema.TPU_AGG_TARGET_UP.name, ())
+            }
+            leaf_up_ok = all(
+                s.value == 1.0
+                for s in fams.get(schema.TPU_ROOT_LEAF_UP.name, ())
+            )
+            members_up = (
+                set(target_up) == set(self.membership)
+                and all(v == 1.0 for v in target_up.values())
+            )
+            if leaf_up_ok and members_up:
+                oracle_problems = _compare_oracle(
+                    _family_values(body),
+                    _family_values(self.sim.oracle_body()),
+                )
+                if not oracle_problems:
+                    recovered = True
+                    break
+            time.sleep(0.15)
+        result["recovered"] = recovered
+        if not recovered:
+            self.problems.append(
+                "stack did not converge back to healthy + oracle-equal "
+                "within the settle budget (quarantine black-hole after "
+                "heal?)")
+            return False
+
+        # /readyz healthy again, over the wire.
+        doc = _get_json(f"http://127.0.0.1:{self.root_server.port}/readyz")
+        result["readyz_state"] = doc.get("state")
+        if doc.get("state") != "ready":
+            self.problems.append(
+                f"/readyz stuck at {doc.get('state')!r} after recovery")
+
+        # (4) series accounting after churn: per-target series must match
+        # final membership EXACTLY (no ghosts from removed targets), and
+        # the workload surface must not have accreted label-churn corpses.
+        fams = parse_families(self.sim.root_body())
+        target_series = {
+            s.labels["target"]
+            for s in fams.get(schema.TPU_AGG_TARGET_UP.name, ())
+        }
+        if target_series != set(self.membership):
+            ghosts = target_series - set(self.membership)
+            missing = set(self.membership) - target_series
+            self.problems.append(
+                f"series leak: {len(ghosts)} ghost target series "
+                f"({sorted(ghosts)[:3]}), {len(missing)} missing")
+        n_workloads = len(
+            fams.get(schema.TPU_WORKLOAD_HBM_USED_BYTES.name, ()))
+        if self.baseline_workloads and n_workloads > (
+                self.baseline_workloads + 2 * len(self.sim.topology) + 8):
+            self.problems.append(
+                f"workload series grew {self.baseline_workloads} -> "
+                f"{n_workloads} across churn (label-set leak)")
+        rss = _utils.process_rss_bytes()
+        if self.rss_baseline and rss and (
+                rss - self.rss_baseline > 128 * 2**20):
+            self.problems.append(
+                f"RSS grew {(rss - self.rss_baseline) / 2**20:.0f} MiB "
+                f"across the scenario (leak)")
+        result["rss_growth_mb"] = (
+            round((rss - self.rss_baseline) / 2**20, 1)
+            if rss and self.rss_baseline else None
+        )
+
+        # (1) egress exactly-once: everything framed must have landed,
+        # contiguous and duplicate-free, after the backlog drains.
+        if self.shipper is not None:
+            drained = self._await_drain()
+            stats = self.shipper.stats()
+            ledger = self.receiver.stats()
+            seqs = ledger["accepted_seqs"]
+            result["egress"] = {
+                "batches": stats["enqueued_batches"],
+                "accepted": len(seqs),
+                "duplicate_seqs": len(ledger["duplicate_seqs"]),
+                "duplicate_samples": ledger["duplicate_samples"],
+                "breaker_reopens": stats["breaker_reopens"],
+                "drained": drained,
+            }
+            if not drained:
+                self.problems.append(
+                    f"egress backlog failed to drain after heal "
+                    f"({stats['backlog_batches']} batches stuck, breaker "
+                    f"{stats['breaker_state']})")
+            if sorted(seqs) != list(range(1, len(seqs) + 1)):
+                self.problems.append(
+                    f"acked-sample loss: accepted seqs not contiguous "
+                    f"({sorted(seqs)[:5]}…)")
+            if stats["enqueued_batches"] != len(seqs):
+                self.problems.append(
+                    f"acked-sample loss: {stats['enqueued_batches']} "
+                    f"batches framed, {len(seqs)} delivered")
+            if ledger["duplicate_seqs"] or ledger["duplicate_samples"]:
+                self.problems.append(
+                    f"egress re-sent acked data: "
+                    f"{len(ledger['duplicate_seqs'])} duplicate batches, "
+                    f"{ledger['duplicate_samples']} duplicate samples")
+        return not self.problems
+
+    def _await_drain(self, timeout_s: float = 20.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            stats = self.shipper.stats()
+            if (stats["backlog_batches"] == 0
+                    and self.receiver.accepted_batches()
+                    >= stats["enqueued_batches"]):
+                return True
+            time.sleep(0.2)
+        return False
+
+    def _close(self) -> None:
+        try:
+            self.root_server.stop()
+        except Exception:  # noqa: BLE001 — teardown must finish
+            pass
+        self.plane.close()
+        if self.shipper is not None:
+            self.shipper.close()
+        if self.receiver is not None:
+            self.receiver.stop()
+        self.sim.close()
+
+
+def run_scenarios(names: list[str], n_targets: int, shards: int,
+                  chips: int, state_root: str, seed: int) -> dict:
+    """Run the named scenarios back to back, each on a fresh stack (own
+    state dir under ``state_root``); returns the summary dict the demo
+    prints and writes as the CI artifact."""
+    os.makedirs(state_root, exist_ok=True)
+    summary: dict = {
+        "ok": True, "targets": n_targets, "shards": shards,
+        "seed": seed, "scenarios": {},
+    }
+    all_traces: dict[str, list] = {}
+    for name in names:
+        scn = SCENARIOS[name]
+        t0 = time.monotonic()
+        run = _Run(scn, n_targets, shards, chips,
+                   os.path.join(state_root, name), seed)
+        result = run.run()
+        result["wall_s"] = round(time.monotonic() - t0, 2)
+        all_traces[name] = run.trace
+        summary["scenarios"][name] = result
+        summary["ok"] = summary["ok"] and result["ok"]
+        status = "ok" if result["ok"] else "FAILED"
+        print(f"  {name:<22} {status:<7} {result['wall_s']:6.1f}s  "
+              f"{'; '.join(result.get('problems', [])[:1])}",
+              flush=True)
+        if not result["ok"]:
+            break  # later scenarios would only bury the first failure
+    try:
+        with open(os.path.join(state_root, "result.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(summary, f, indent=1)
+        # The per-tick invariant record IS the forensics: which rounds
+        # had which cuts, what the exposition said, what failed.
+        with open(os.path.join(state_root, "scenario-trace.json"), "w",
+                  encoding="utf-8") as f:
+            json.dump(all_traces, f, indent=1)
+    except OSError:
+        pass
+    return summary
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="tpu-loadgen-scenario",
+        description="Fleet scenario engine: declarative chaos timelines "
+                    "with per-tick invariants against the full "
+                    "node→leaf→root→egress stack (make scenario-demo).",
+    )
+    p.add_argument("--scenarios", default="all",
+                   help="comma-separated scenario names, or 'all' "
+                        f"(known: {', '.join(SCENARIOS)})")
+    p.add_argument("--timeline", default="",
+                   help="ad-hoc scenario: run this DSL timeline instead "
+                        "of the named set (see tpu_pod_exporter.scenario "
+                        "for the grammar)")
+    p.add_argument("--targets", type=int, default=120)
+    p.add_argument("--shards", type=int, default=4)
+    p.add_argument("--chips", type=int, default=2)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--state-root", default="scenario-demo-state",
+                   help="per-scenario state dirs + result.json + "
+                        "scenario-trace.json (uploaded as a CI artifact "
+                        "on failure)")
+    p.add_argument("--log-level", default="warning")
+    ns = p.parse_args(argv)
+    _utils.setup_logging(ns.log_level)
+
+    if ns.timeline:
+        adhoc = Scenario(name="adhoc", timeline=ns.timeline,
+                         description="operator-supplied timeline")
+        SCENARIOS["adhoc"] = adhoc
+        names = ["adhoc"]
+    elif ns.scenarios == "all":
+        names = list(DEFAULT_SCENARIO_ORDER)
+    else:
+        names = [s.strip() for s in ns.scenarios.split(",") if s.strip()]
+        unknown = [n for n in names if n not in SCENARIOS]
+        if unknown:
+            p.error(f"unknown scenario(s) {unknown}; "
+                    f"known: {', '.join(SCENARIOS)}")
+    print(f"scenario engine: {len(names)} scenario(s), {ns.targets} "
+          f"targets / {ns.shards} HA shards, seed {ns.seed}")
+    summary = run_scenarios(names, ns.targets, ns.shards, ns.chips,
+                            ns.state_root, ns.seed)
+    if not summary["ok"]:
+        failed = [n for n, r in summary["scenarios"].items()
+                  if not r["ok"]]
+        print(f"SCENARIO DEMO FAILED: {failed} — see "
+              f"{ns.state_root}/scenario-trace.json", file=sys.stderr)
+        return 1
+    total = sum(r["wall_s"] for r in summary["scenarios"].values())
+    print(f"scenario-demo OK: {len(names)} scenario(s) in {total:.1f}s — "
+          f"all per-tick invariants held (zero acked-sample loss, bounded "
+          f"staleness, oracle-equal outside windows, no series leaks, "
+          f"faults exposition-attributable)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

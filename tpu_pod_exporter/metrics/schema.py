@@ -1032,6 +1032,20 @@ TPU_ROOT_SHARD_QUARANTINED_TARGETS = MetricSpec(
     label_names=("shard",),
 )
 
+TPU_ROOT_LEAF_STALE_SERVED = MetricSpec(
+    name="tpu_root_leaf_stale_served",
+    help="1 while the root is merging this leaf's LAST-KNOWN view because the leaf is currently unreachable (within --stale-serve-s). The fleet view stays populated through a root-leaf network partition — stale-but-labeled, never vanished; tpu_root_leaf_staleness_seconds says how stale.",
+    type=GAUGE,
+    label_names=("shard", "leaf"),
+)
+
+TPU_ROOT_LEAF_PARTITION_SUSPECTED = MetricSpec(
+    name="tpu_root_leaf_partition_suspected",
+    help="1 while this leaf is unreachable from the root but was healthy moments ago AND its HA twin still answers — the one-sided-unreachability shape of a network partition between root and leaf, as opposed to a dead leaf (whose liveness probe would be restarting it). TpuRootLeafPartitioned alerts on it.",
+    type=GAUGE,
+    label_names=("shard", "leaf"),
+)
+
 TPU_ROOT_DEDUP_STALE_WINS_TOTAL = MetricSpec(
     name="tpu_root_dedup_stale_wins_total",
     help="Series groups where the HA dedup had to take a STALER leaf's value because the shard's freshest answering leaf did not carry the series (e.g. a just-restarted leaf mid-warmup). Zero in steady state; a sustained rate means an HA pair disagrees about its shard.",
@@ -1065,6 +1079,8 @@ TPU_ROOT_ROUND_HIST = HistogramSpec(
 ROOT_SPECS: tuple[MetricSpec, ...] = (
     TPU_ROOT_LEAF_UP,
     TPU_ROOT_LEAF_STALENESS_SECONDS,
+    TPU_ROOT_LEAF_STALE_SERVED,
+    TPU_ROOT_LEAF_PARTITION_SUSPECTED,
     TPU_ROOT_SHARD_TARGETS,
     TPU_ROOT_SHARD_QUARANTINED_TARGETS,
     TPU_ROOT_DEDUP_STALE_WINS_TOTAL,

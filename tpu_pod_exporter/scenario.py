@@ -1,0 +1,383 @@
+"""Declarative fleet scenario timelines — the chaos DSL for end-to-end drills.
+
+Every chaos tool so far is per-subsystem: ``chaos.py`` wraps one source,
+``LeafKillHook`` kills one leaf, ``ChaosReceiver`` flaps one receiver. A
+real TPU-fleet incident is a *composition* — a network partition during a
+reshard during an egress backlog drain — and nothing scripted those
+against the whole stack. This module is the timeline language for the
+fleet scenario engine (``tpu_pod_exporter.loadgen.scenario``): a seeded,
+deterministic schedule of named events on a logical round clock, parsed
+up front with loud, actionable errors (the ``parse_chaos_spec`` contract:
+a typo'd drill must fail at parse time, not silently inject nothing).
+
+Grammar (``--timeline``; events separated by ``;`` or top-level ``,``)::
+
+    timeline := event ((";" | ",") event)*
+    event    := kind "(" args ")" "@" round ["+" duration]
+
+    partition(tierA<->tierB, symmetric|asymmetric|flapping)
+    preempt(slice-N)                  SIGTERM-shaped: every host of the slice
+    restart_wave(N [, stagger=K])     N hosts restart, K per round
+    churn_storm(N)                    N targets removed+added per window,
+                                      plus a workload label-churn wave
+    hotspot(podname)                  one workload's duty/HBM spikes
+    recv_outage()                     the remote-write receiver answers 503
+
+``@round`` is the event's first engine round (0-based); ``+duration`` is
+the window length in rounds (default 1). Examples::
+
+    partition(leaf<->root, symmetric)@3+3
+    partition(leaf<->root, asymmetric)@2+4; recv_outage()@4+2
+    preempt(slice-2)@3+3, restart_wave(6, stagger=2)@8
+
+Partition semantics (interpreted by the engine through
+``chaos.PartitionState``):
+
+- ``symmetric`` — every edge between the two tiers is cut, both logical
+  directions (for an HTTP pull seam the fetch direction is the wire; a
+  symmetric tier cut means *no* leaf of an HA pair is reachable).
+- ``asymmetric`` — a one-sided cut: only the FIRST leaf of each HA pair
+  (or, for ``node<->leaf``, only the ``a`` leaves' paths) loses the edge,
+  so every shard keeps a healthy path via its twin. This is the
+  "reachable by everyone except the root" shape the HA dedup must absorb
+  without losing a series or flapping the freshest-wins winner.
+- ``flapping`` — the cut alternates open/cut per engine round on a
+  seeded phase (``chaos.Cut``), the shape that punishes breakers whose
+  half-open probe success resets their backoff.
+
+Named scenarios (the ``make scenario-demo`` set) live in
+:data:`SCENARIOS`; each is just a timeline string plus the engine's
+per-tick invariants, so new drills are one dict entry, not new code.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+EVENT_KINDS: tuple[str, ...] = (
+    "partition", "preempt", "restart_wave", "churn_storm", "hotspot",
+    "recv_outage",
+)
+
+TIERS: tuple[str, ...] = ("node", "leaf", "root", "recv")
+
+PARTITION_MODES: tuple[str, ...] = ("symmetric", "asymmetric", "flapping")
+
+# Tier pairs the engine knows how to cut (unordered): the three seams the
+# stack actually crosses. node<->root would be meaningless (the root never
+# talks to nodes) and is rejected at parse time.
+PARTITION_EDGES: frozenset[frozenset[str]] = frozenset({
+    frozenset({"node", "leaf"}),
+    frozenset({"leaf", "root"}),
+    frozenset({"root", "recv"}),
+})
+
+_EVENT_RE = re.compile(
+    r"^(?P<kind>[a-z_]+)\((?P<args>[^()]*)\)"
+    r"@(?P<round>-?\d+)(?:\+(?P<dur>-?\d+))?$"
+)
+_EDGE_RE = re.compile(r"^(?P<a>[a-z]+)\s*<->\s*(?P<b>[a-z]+)$")
+_SLICE_RE = re.compile(r"^slice-(?P<n>\d+)$")
+
+
+@dataclass
+class ScenarioEvent:
+    """One parsed timeline event. ``at_round`` .. ``end_round`` (exclusive)
+    is the injected window; single-round events have duration 1."""
+
+    kind: str
+    at_round: int
+    duration: int = 1
+    edge: tuple[str, str] | None = None  # partition: (tierA, tierB) as given
+    mode: str = ""                       # partition: symmetric|asymmetric|flapping
+    subject: str = ""                    # preempt: slice id; hotspot: pod
+    count: int = 0                       # restart_wave / churn_storm
+    stagger: int = 1                     # restart_wave: hosts per round
+    raw: str = field(default="", compare=False)
+
+    @property
+    def end_round(self) -> int:
+        return self.at_round + self.duration
+
+    def overlap_key(self) -> tuple:
+        """Identity for the no-overlapping-events rule: two events with the
+        same key may not have intersecting windows (the engine cannot
+        apply e.g. two preempts of the same slice at once, and silently
+        merging them would make the drill lie about what it injected)."""
+        if self.kind == "partition":
+            return ("partition", frozenset(self.edge or ()))
+        if self.kind in ("preempt", "hotspot"):
+            return (self.kind, self.subject)
+        return (self.kind,)
+
+
+def _err(raw: str, msg: str) -> ValueError:
+    return ValueError(f"scenario event {raw!r}: {msg}")
+
+
+def _split_events(spec: str) -> list[str]:
+    """Split a timeline on ``;`` and top-level ``,`` (commas inside an
+    event's parens belong to its arg list)."""
+    out: list[str] = []
+    buf: list[str] = []
+    depth = 0
+    for ch in spec:
+        if ch == "(":
+            depth += 1
+        elif ch == ")":
+            depth = max(depth - 1, 0)
+        if ch == ";" or (ch == "," and depth == 0):
+            out.append("".join(buf))
+            buf = []
+        else:
+            buf.append(ch)
+    out.append("".join(buf))
+    return [s.strip() for s in out if s.strip()]
+
+
+def parse_event(raw: str) -> ScenarioEvent:
+    """One event string → :class:`ScenarioEvent`; raises ValueError with a
+    message naming the offending token and what would be accepted."""
+    m = _EVENT_RE.match(raw.strip())
+    if m is None:
+        raise _err(raw, "want kind(args)@round[+duration], e.g. "
+                        "partition(leaf<->root, symmetric)@3+2")
+    kind = m.group("kind")
+    if kind not in EVENT_KINDS:
+        raise _err(raw, f"unknown event kind {kind!r} "
+                        f"(want one of {'/'.join(EVENT_KINDS)})")
+    at_round = int(m.group("round"))
+    if at_round < 0:
+        raise _err(raw, f"round {at_round} is negative — the timeline "
+                        f"starts at round 0")
+    duration = int(m.group("dur")) if m.group("dur") is not None else 1
+    if duration < 1:
+        raise _err(raw, f"duration +{duration} must be at least +1 round")
+    args = [a.strip() for a in m.group("args").split(",") if a.strip()]
+    ev = ScenarioEvent(kind=kind, at_round=at_round, duration=duration,
+                       raw=raw.strip())
+
+    if kind == "partition":
+        if len(args) != 2:
+            raise _err(raw, "partition wants exactly (tierA<->tierB, mode)")
+        em = _EDGE_RE.match(args[0])
+        if em is None:
+            raise _err(raw, f"bad edge {args[0]!r}: want tierA<->tierB "
+                            f"with tiers from {'/'.join(TIERS)}")
+        a, b = em.group("a"), em.group("b")
+        for t in (a, b):
+            if t not in TIERS:
+                raise _err(raw, f"unknown tier {t!r} "
+                                f"(want one of {'/'.join(TIERS)})")
+        if a == b:
+            raise _err(raw, f"edge {args[0]!r} connects {a!r} to itself")
+        if frozenset({a, b}) not in PARTITION_EDGES:
+            valid = ", ".join(sorted(
+                "<->".join(sorted(e)) for e in PARTITION_EDGES))
+            raise _err(raw, f"the stack has no {a}<->{b} seam "
+                            f"(cuttable edges: {valid})")
+        if args[1] not in PARTITION_MODES:
+            raise _err(raw, f"unknown partition mode {args[1]!r} "
+                            f"(want one of {'/'.join(PARTITION_MODES)})")
+        ev.edge = (a, b)
+        ev.mode = args[1]
+        return ev
+
+    if kind == "preempt":
+        if len(args) != 1:
+            raise _err(raw, "preempt wants exactly (slice-N)")
+        if _SLICE_RE.match(args[0]) is None:
+            raise _err(raw, f"bad slice coordinate {args[0]!r}: want "
+                            f"slice-N (the farm's slice ids)")
+        ev.subject = args[0]
+        return ev
+
+    if kind == "restart_wave":
+        if not args or len(args) > 2:
+            raise _err(raw, "restart_wave wants (N[, stagger=K])")
+        try:
+            ev.count = int(args[0])
+        except ValueError:
+            raise _err(raw, f"bad host count {args[0]!r}: want an integer"
+                       ) from None
+        if ev.count < 1:
+            raise _err(raw, f"host count {ev.count} must be >= 1")
+        if len(args) == 2:
+            k, sep, v = args[1].partition("=")
+            if not sep or k.strip() != "stagger":
+                raise _err(raw, f"unknown restart_wave option {args[1]!r} "
+                                f"(want stagger=K)")
+            try:
+                ev.stagger = int(v)
+            except ValueError:
+                raise _err(raw, f"bad stagger {v!r}: want an integer"
+                           ) from None
+            if ev.stagger < 1:
+                raise _err(raw, f"stagger {ev.stagger} must be >= 1")
+        # A wave IS its own duration: ceil(count / stagger) rounds of
+        # restarts. An explicit +duration on a wave would either truncate
+        # it (silently skipping restarts) or pad it (idle rounds lying in
+        # the injected window), so it is rejected.
+        if m.group("dur") is not None:
+            raise _err(raw, "restart_wave derives its duration from "
+                            "count/stagger; drop the +duration")
+        ev.duration = -(-ev.count // ev.stagger)
+        return ev
+
+    if kind == "churn_storm":
+        if len(args) != 1:
+            raise _err(raw, "churn_storm wants exactly (N targets per wave)")
+        try:
+            ev.count = int(args[0])
+        except ValueError:
+            raise _err(raw, f"bad churn size {args[0]!r}: want an integer"
+                       ) from None
+        if ev.count < 2:
+            raise _err(raw, f"churn size {ev.count} must be >= 2 "
+                            f"(each wave removes and adds)")
+        return ev
+
+    if kind == "hotspot":
+        if len(args) != 1 or not args[0]:
+            raise _err(raw, "hotspot wants exactly (podname)")
+        ev.subject = args[0]
+        return ev
+
+    # recv_outage
+    if args:
+        raise _err(raw, f"recv_outage takes no arguments (got {args})")
+    return ev
+
+
+def parse_scenario(spec: str) -> list[ScenarioEvent]:
+    """Full timeline → event list sorted by start round, with the
+    no-overlap rule enforced across events of the same identity."""
+    events = [parse_event(raw) for raw in _split_events(spec)]
+    if not events:
+        raise ValueError(f"scenario timeline {spec!r} contains no events")
+    events.sort(key=lambda e: (e.at_round, e.raw))
+    by_key: dict[tuple, ScenarioEvent] = {}
+    for ev in events:
+        prev = by_key.get(ev.overlap_key())
+        if prev is not None and ev.at_round < prev.end_round:
+            raise ValueError(
+                f"scenario events {prev.raw!r} and {ev.raw!r} overlap "
+                f"(rounds {ev.at_round}..{min(prev.end_round, ev.end_round) - 1}); "
+                f"the engine applies one event per identity at a time — "
+                f"stagger them or merge the windows"
+            )
+        by_key[ev.overlap_key()] = ev
+    return events
+
+
+def total_rounds(events: list[ScenarioEvent], settle: int = 3) -> int:
+    """Driver rounds a timeline needs: past the last window plus settle
+    rounds for heal/recovery assertions."""
+    return max(ev.end_round for ev in events) + settle
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """One named drill: a timeline plus what the engine should expect."""
+
+    name: str
+    timeline: str
+    description: str
+    # Tunables the engine reads:
+    settle_rounds: int = 3
+    uses_egress: bool = True
+
+    def events(self) -> list[ScenarioEvent]:
+        return parse_scenario(self.timeline)
+
+
+# The make scenario-demo set. Round coordinates assume the engine's 2
+# warmup rounds (0-1) before any window opens; every scenario ends with
+# settle rounds in which the stack must return to oracle-equal health.
+SCENARIOS: dict[str, Scenario] = {
+    s.name: s
+    for s in (
+        Scenario(
+            name="partition_symmetric",
+            timeline="partition(leaf<->root, symmetric)@3+3",
+            description=(
+                "Every leaf unreachable from the root for 3 rounds: the "
+                "root must keep serving last-known shard data (stale-but-"
+                "labeled, leaf_up=0, staleness growing), flip /readyz to "
+                "degraded, and converge back to oracle-equal after heal."
+            ),
+        ),
+        Scenario(
+            name="partition_asymmetric",
+            timeline="partition(leaf<->root, asymmetric)@3+4",
+            description=(
+                "The root loses one leaf of every HA pair while the twins "
+                "stay reachable: zero series lost, rollups oracle-equal "
+                "THROUGH the window (the twin is fresh), partition "
+                "suspicion attributable per cut leaf, and the two-level "
+                "query plane stays partial-free."
+            ),
+        ),
+        Scenario(
+            name="partition_flapping",
+            timeline=(
+                "partition(leaf<->root, flapping)@3+6; "
+                "partition(root<->recv, flapping)@3+6"
+            ),
+            description=(
+                "Alternating cuts on the root-leaf and egress seams: "
+                "freshest-wins must not flap (no series lost any round), "
+                "and the egress breaker's half-open probes must not reset "
+                "its backoff each open half-round — the ledger stays "
+                "exactly-once through the whole window."
+            ),
+        ),
+        Scenario(
+            name="preempt_slice",
+            timeline="preempt(slice-1)@3+3",
+            description=(
+                "Slice preemption: every host of slice-1 goes down for 3 "
+                "rounds (quarantines learned), then returns — the healed "
+                "targets must be re-admitted by the leaf breakers within "
+                "the backoff budget, never black-holed as dead."
+            ),
+            settle_rounds=4,
+        ),
+        Scenario(
+            name="restart_wave",
+            timeline="restart_wave(6, stagger=2)@3; hotspot(job-3)@3+4",
+            description=(
+                "A 6-host rolling restart, 2 per round, composed with a "
+                "workload hotspot: never more than one stagger-width of "
+                "targets down in any round (read from the exposition), "
+                "the hot pod attributable from the workload rollups "
+                "while hosts churn, full recovery after the wave."
+            ),
+        ),
+        Scenario(
+            name="churn_storm",
+            timeline="churn_storm(16)@3+2",
+            description=(
+                "Target add/remove waves through the shared targets file "
+                "plus a workload label-churn storm: bounded reshard "
+                "moves, and NO series or RSS leak — the exposition "
+                "returns to exactly the expected series set after settle."
+            ),
+            settle_rounds=4,
+        ),
+        Scenario(
+            name="recv_outage",
+            timeline="recv_outage()@3+4",
+            description=(
+                "The remote-write receiver answers 503 for 4 rounds: the "
+                "egress breaker opens (attributable from the egress "
+                "exposition), the backlog buffers to disk, and the drain "
+                "after heal delivers every batch exactly once."
+            ),
+            settle_rounds=4,
+        ),
+    )
+}
+
+DEFAULT_SCENARIO_ORDER: tuple[str, ...] = tuple(SCENARIOS)

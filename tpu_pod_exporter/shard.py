@@ -645,6 +645,7 @@ class RootAggregator:
         shard_map: ShardMap | None = None,
         shard_map_store: Any = None,  # persist.ShardMapFile | None
         breaker_store: Any = None,  # persist.BreakerStateFile | None
+        stale_serve_s: float = 0.0,
     ) -> None:
         if not topology:
             raise ValueError("root needs at least one shard of leaves")
@@ -690,6 +691,22 @@ class RootAggregator:
         # Last seen round ts per leaf: a dead leaf's staleness keeps
         # GROWING (published from here), instead of vanishing with its body.
         self._leaf_ts: dict[str, float] = {}
+        # Partition tolerance (the scenario drills' hardening): keep each
+        # leaf's last successfully-folded view for up to stale_serve_s and
+        # MERGE it while the leaf is unreachable — the fleet view degrades
+        # to stale-but-labeled (leaf_up=0, staleness growing,
+        # tpu_root_leaf_stale_served=1) instead of vanishing, and because
+        # the cached view's round_ts is frozen, the HA freshest-wins
+        # winner cannot flap while a flapping cut strobes reachability.
+        # 0 disables (a vanished leaf's series drop out immediately, the
+        # pre-hardening behavior the both-leaves-dead tests pin for the
+        # disabled case).
+        self._stale_serve_s = stale_serve_s
+        self._last_views: dict[str, tuple[LeafView, float]] = {}
+        # Last round's health summary, read by ready_detail() from HTTP
+        # threads (swapped atomically as a tuple).
+        self._health: tuple[int, int, int, tuple[str, ...]] = (
+            0, len(self._leaves), 0, ())
         # Reshard accounting: the root re-derives the global assignment
         # map from the same targets file the leaves read and counts the
         # delta per reload — the fleet-level churn signal
@@ -846,9 +863,36 @@ class RootAggregator:
         views: dict[str, LeafView] = {
             leaf: view for leaf, view, _d in results if view is not None
         }
+        reachable = frozenset(views)
         now_wall = self._wallclock()
         for leaf, view in views.items():
             self._leaf_ts[leaf] = view.round_ts
+            self._last_views[leaf] = (view, now_wall)
+        # Stale-serve: an unreachable leaf's last-known view keeps its
+        # shard populated for up to stale_serve_s. The cached view joins
+        # the merge with its ORIGINAL round_ts, so a reachable twin (being
+        # fresher) wins every shared group and the cache only fills what
+        # nothing fresher carries — zero series lost, no winner flap.
+        stale_served: set[str] = set()
+        if self._stale_serve_s > 0:
+            for leaf in self._leaves:
+                if leaf in views:
+                    continue
+                cached = self._last_views.get(leaf)
+                if cached is not None and (
+                        now_wall - cached[1] <= self._stale_serve_s):
+                    views[leaf] = cached[0]
+                    stale_served.add(leaf)
+        # Partition suspicion: one-sided unreachability — the leaf was
+        # healthy moments ago (we are stale-serving its view) while its
+        # HA twin still answers. A DEAD leaf trips its own liveness probe
+        # and restarts; persistent one-sided cut is a partition shape.
+        suspected: set[str] = set()
+        for shard, leaves in self.topology.items():
+            if any(leaf in reachable for leaf in leaves):
+                suspected.update(
+                    leaf for leaf in leaves if leaf in stale_served
+                )
         merged: dict[str, ShardMerged] = {}
         stale_wins = 0
         for shard, leaves in self.topology.items():
@@ -860,7 +904,12 @@ class RootAggregator:
         if stale_wins:
             self._counters.inc(schema.TPU_ROOT_DEDUP_STALE_WINS_TOTAL.name,
                                (), float(stale_wins))
-        self._publish(results, views, merged, now_wall, t0)
+        self._health = (
+            len(reachable), len(self._leaves), len(stale_served),
+            tuple(sorted(suspected)),
+        )
+        self._publish(results, views, merged, now_wall, t0,
+                      stale_served=stale_served, suspected=suspected)
         # AFTER publish, same discipline as the leaf tier: disk latency
         # during a leaf incident must not read as round time.
         self._leaf_set.maybe_save_breakers()
@@ -872,7 +921,11 @@ class RootAggregator:
         merged: Mapping[str, ShardMerged],
         now_wall: float,
         round_started: float,
+        stale_served: set[str] | None = None,
+        suspected: set[str] | None = None,
     ) -> None:
+        stale_served = stale_served or set()
+        suspected = suspected or set()
         b = SnapshotBuilder()
         # Stable surface: fleet rollups + per-target passthrough + root
         # self-metrics, declared every round whether or not sampled.
@@ -923,8 +976,14 @@ class RootAggregator:
         # Root self-surface: per-leaf health + per-shard occupancy.
         for leaf, view, _dur in results:
             shard = self._shard_of[leaf]
+            # up reflects REACHABILITY this round — a stale-served leaf is
+            # still down (stale-serve is labeled continuity, not health).
             b.add(schema.TPU_ROOT_LEAF_UP,
                   1.0 if view is not None else 0.0, (shard, leaf))
+            b.add(schema.TPU_ROOT_LEAF_STALE_SERVED,
+                  1.0 if leaf in stale_served else 0.0, (shard, leaf))
+            b.add(schema.TPU_ROOT_LEAF_PARTITION_SUSPECTED,
+                  1.0 if leaf in suspected else 0.0, (shard, leaf))
             ts = self._leaf_ts.get(leaf)
             if ts:
                 b.add(schema.TPU_ROOT_LEAF_STALENESS_SECONDS,
@@ -962,11 +1021,39 @@ class RootAggregator:
         self._store.swap(snap)
         self._round_hist.observe(round_dur)
 
+    def ready_detail(self) -> dict:
+        """/readyz detail hook (``server.MetricsServer ready_detail_fn``):
+        the root keeps answering HTTP 200 through a partition — last-known
+        data IS being served — but flips ``state`` to ``degraded`` with an
+        operator-readable reason once NO leaf is reachable, and surfaces
+        per-leaf stale-serve/suspicion either way."""
+        reachable, total, stale_served, suspected = self._health
+        out: dict = {
+            "leaf_tier": {
+                "reachable": reachable,
+                "total": total,
+                "stale_served": stale_served,
+                "partition_suspected": list(suspected),
+            },
+        }
+        if total and reachable == 0 and self.rounds > 0:
+            out["degraded_sources"] = [
+                f"leaf-tier: 0/{total} leaves reachable — serving "
+                f"last-known shard data"
+                + (f" ({stale_served} leaf view(s) stale-served)"
+                   if stale_served else "")
+                + "; root-side network partition suspected"
+            ]
+        return out
+
     def debug_vars(self) -> dict:
         return {
             "topology": {s: list(ls) for s, ls in self.topology.items()},
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
+            "stale_serve_s": self._stale_serve_s,
+            "stale_served_leaves": self._health[2],
+            "partition_suspected": list(self._health[3]),
             "leaf_round_ts": dict(self._leaf_ts),
             "assignments": len(self._assignments),
             "leaf_breakers": (
@@ -1325,6 +1412,14 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--fleet-query", default="on", choices=("on", "off"),
                    help="[root] two-level /api/v1 fan-out through the "
                         "leaves' federated query planes")
+    p.add_argument("--stale-serve-s", type=float, default=0.0,
+                   help="[root] keep merging an unreachable leaf's LAST-"
+                        "KNOWN view for this many seconds (leaf_up stays "
+                        "0, staleness grows, tpu_root_leaf_stale_served "
+                        "flags it) so a root-leaf network partition "
+                        "degrades the fleet view to stale-but-labeled "
+                        "instead of emptying it; 0 disables, try 3x "
+                        "--interval-s")
     ns = p.parse_args(argv)
     utils.setup_logging(ns.log_level, ns.log_format)
     if ns.role == "leaf":
@@ -1408,6 +1503,7 @@ def _run_leaf(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         debug_vars=agg.debug_vars, debug_addr=ns.debug_addr, fleet=fleet,
+        ready_detail_fn=agg.ready_detail,
     )
     agg.poll_once()  # synchronous first round so /readyz flips immediately
     log.info("leaf %s (%s) aggregating %d/%s targets on :%d every %.1fs",
@@ -1456,6 +1552,7 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         shard_map=shard_map,
         shard_map_store=shard_map_store,
         breaker_store=breaker_store,
+        stale_serve_s=ns.stale_serve_s,
     )
     plane = None
     if ns.fleet_query == "on":
@@ -1466,6 +1563,7 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         debug_vars=root.debug_vars, debug_addr=ns.debug_addr, fleet=plane,
+        ready_detail_fn=root.ready_detail,
     )
     root.poll_once()
     log.info("root merging %d shard(s) / %d leaf(s) on :%d every %.1fs",

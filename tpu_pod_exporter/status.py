@@ -64,14 +64,7 @@ def main(argv=None) -> int:
         try:
             if ns.watch <= 0:
                 return _run_tree(ns.tree, as_json=ns.json)
-            while True:
-                if not ns.json:
-                    print("\x1b[H\x1b[2J", end="")
-                rc = _run_tree(ns.tree,
-                               as_json="line" if ns.json else False)
-                if rc != 0:
-                    return rc
-                time.sleep(ns.watch)
+            return _watch_tree(ns.tree, ns.watch, as_json=ns.json)
         except KeyboardInterrupt:
             return 0
     if ns.fleet:
@@ -355,6 +348,67 @@ def render_tree(doc: dict) -> str:
     out.append("")
     out.append(footer)
     return "\n".join(out)
+
+
+def render_tree_screen(addr: str, doc: dict | None, error=None,
+                       unreachable_s: float = 0.0) -> str:
+    """One watch-mode frame: the freshest tree we have, plus an explicit
+    ``unreachable`` footer when the root is not answering right now.
+    A briefly-unreachable root (it restarts, a partition blips) must not
+    throw the operator out of watch mode mid-incident — the last-known
+    state labeled stale beats a dead terminal."""
+    out = [f"shard tree via {addr}", ""]
+    if doc is not None:
+        out.append(render_tree(doc))
+    if error is not None:
+        if doc is not None:
+            out.append("")
+            out.append(
+                f"root unreachable ({unreachable_s:.0f}s): {error} — "
+                f"showing last-known state"
+            )
+        else:
+            out.append(f"root unreachable: {error} (no tree fetched yet)")
+    return "\n".join(out)
+
+
+def _watch_tree(addr: str, interval_s: float, as_json=False) -> int:
+    """``--tree --watch``: re-render until interrupted, surviving root
+    outages with a last-known-state footer instead of exiting."""
+    import json as _json
+
+    last_doc: dict | None = None
+    last_ok = time.monotonic()
+    while True:
+        error = None
+        try:
+            doc = fetch_tree(addr)
+            last_doc = doc
+            last_ok = time.monotonic()
+        except Exception as e:  # noqa: BLE001 — watch mode outlives outages
+            error = e
+        if as_json:
+            # JSONL stream: one object per interval; outages are explicit
+            # records (with the last-known doc attached), never an exit.
+            if error is None:
+                print(_json.dumps(doc, indent=None), flush=True)
+            else:
+                print(_json.dumps({
+                    "root": addr,
+                    "unreachable": True,
+                    "unreachable_s": round(time.monotonic() - last_ok, 1),
+                    "error": str(error),
+                    "last_known": last_doc,
+                }, indent=None), flush=True)
+        else:
+            print("\x1b[H\x1b[2J", end="")
+            print(render_tree_screen(
+                addr,
+                last_doc,
+                error=error,
+                unreachable_s=time.monotonic() - last_ok,
+            ))
+        time.sleep(interval_s)
 
 
 def _run_tree(addr: str, as_json=False) -> int:

@@ -52,6 +52,17 @@ STATE_VALUES = {CLOSED: 0.0, OPEN: 1.0, HALF_OPEN: 2.0}
 # is an incident in progress, "open across N probes" is a wedged source.
 DEGRADED_AFTER_REOPENS = 3
 
+# Consecutive successes AFTER a half-open probe success before the breaker
+# forgets its backoff history. One probe succeeding proves only that one
+# request got through — under a flapping network partition that is the
+# NORMAL failure shape (the scenario drills' flapping-partition case): a
+# full reset on the probe would restart every incident at the base backoff
+# and probe-storm the unreachable endpoint forever. Until this many
+# follow-up successes land, a re-open resumes from the retained (halved)
+# backoff and the cumulative reopen count, so a flapping cut settles at
+# the backoff ceiling instead of oscillating at the base.
+PROBATION_SUCCESSES = 2
+
 
 class SourceTimeout(RuntimeError):
     """A supervised call exceeded its phase deadline and was abandoned."""
@@ -78,7 +89,7 @@ class CircuitBreaker:
     __slots__ = (
         "failure_threshold", "backoff_base_s", "backoff_max_s", "jitter",
         "state", "consecutive_failures", "reopens", "transitions",
-        "_backoff_s", "_next_probe_at", "_clock", "_rng",
+        "_backoff_s", "_next_probe_at", "_clock", "_rng", "_probation",
     )
 
     def __init__(
@@ -109,6 +120,9 @@ class CircuitBreaker:
         self.transitions = {CLOSED: 0, OPEN: 0, HALF_OPEN: 0}
         self._backoff_s = 0.0
         self._next_probe_at = 0.0
+        # Successes still owed before backoff history is forgotten (set by
+        # a half-open probe success; see PROBATION_SUCCESSES).
+        self._probation = 0
         self._clock = clock
         self._rng = rng if rng is not None else random.Random()
 
@@ -126,10 +140,22 @@ class CircuitBreaker:
 
     def record_success(self) -> None:
         self.consecutive_failures = 0
-        self.reopens = 0
-        self._backoff_s = 0.0
         if self.state != CLOSED:
+            # A half-open probe success closes the breaker but keeps the
+            # backoff and the reopen count on probation: one request
+            # surviving a flapping partition must not reset the incident —
+            # the next re-open DOUBLES from here toward the ceiling
+            # instead of restarting the dance at the base backoff.
+            self._probation = PROBATION_SUCCESSES
             self._enter(CLOSED)
+        elif self._probation > 0:
+            self._probation -= 1
+            if self._probation == 0:
+                self.reopens = 0
+                self._backoff_s = 0.0
+        else:
+            self.reopens = 0
+            self._backoff_s = 0.0
 
     def record_failure(self) -> None:
         self.consecutive_failures += 1
