@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -141,6 +141,26 @@ egress-drain-check:
 scenario-demo:
 	python -m tpu_pod_exporter.loadgen.scenario --targets 120 --shards 4 \
 		--state-root scenario-demo-state
+
+# Resource-pressure governor acceptance (deploy/RUNBOOK.md "Resource
+# pressure playbook"): three drills against real components —
+#   disk:   a live exporter (persister + WAL + egress into a ledgered
+#           chaos receiver) on a budget its steady state cannot fit; the
+#           ladder must climb IN ORDER (WAL thinning -> egress compaction
+#           -> checkpoint halving -> WAL off), usage must stop growing,
+#           scraping must keep serving, the egress exactly-once ledger
+#           must end intact, and recovery steps down rung by rung.
+#   memory: history rings + trace ring + fleet cache under a byte budget;
+#           sheds land coarse-tiers-last and the rings keep their NEWEST
+#           samples.
+#   storm:  admission control vs a 500-connection keep-alive storm; a
+#           polite scraper's p99 stays within 5% (+5 ms noise floor) of
+#           its baseline and open connections never exceed the cap.
+# Then the NEGATIVE CONTROL: the disk drill re-runs WITHOUT the governor
+# and must VISIBLY break the budget invariant (exit 0 only when it does).
+pressure-demo:
+	python -m tpu_pod_exporter.pressure --demo
+	python -m tpu_pod_exporter.pressure --negative-control
 
 native:
 	$(MAKE) -C native

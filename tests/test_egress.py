@@ -116,7 +116,7 @@ class TestRemoteWriteProto:
         )
         head, body = parse_batch(frame_batch(7, 123.5, "delta", 1, proto))
         assert head == {"seq": 7, "wall": 123.5, "kind": "delta",
-                        "samples": 1}
+                        "samples": 1, "mono": 0.0}
         assert body == proto
 
     def test_parse_batch_rejects_foreign(self):
@@ -584,17 +584,102 @@ class TestShipperSending:
         sh.close()
 
     def test_backlog_age_cap_drops_oldest(self, tmp_path):
-        clock = {"wall": 1000.0}
+        # Batches created by THIS process age on the MONOTONIC clock (the
+        # clock-step fence: an NTP wall step must never mass-drop a
+        # healthy backlog), so the outage is simulated by advancing both
+        # clocks together — the honest shape of 100 s actually passing.
+        clock = {"wall": 1000.0, "mono": 500.0}
         sh = make_shipper(tmp_path, CollectingSend(fail_until=10**9),
                           max_backlog_age_s=50.0,
-                          wallclock=lambda: clock["wall"])
+                          wallclock=lambda: clock["wall"],
+                          clock=lambda: clock["mono"])
         sh._write_snapshot(up_snap(1000.0))
-        clock["wall"] = 1100.0  # first batch now 100 s old
+        sh._peek_meta()  # sender-side head refresh (reads the mono stamp)
+        clock["wall"] = 1100.0  # 100 s pass (both clocks)
+        clock["mono"] = 600.0
         sh._write_snapshot(up_snap(1100.0))
         sh._enforce_caps()  # normally the sender thread's loop does this
         st = sh.stats()
         assert st["dropped"]["backlog"] == 1
         assert st["backlog_batches"] == 1
+        sh.close()
+
+    def test_wall_step_does_not_mass_drop_backlog(self, tmp_path):
+        # The fence itself: a +1 h WALL step with no real time passing
+        # must not age-cap-drop batches this process created.
+        clock = {"wall": 1000.0, "mono": 500.0}
+        sh = make_shipper(tmp_path, CollectingSend(fail_until=10**9),
+                          max_backlog_age_s=50.0,
+                          wallclock=lambda: clock["wall"],
+                          clock=lambda: clock["mono"])
+        sh._write_snapshot(up_snap(1000.0))
+        sh._peek_meta()
+        clock["wall"] = 1000.0 + 3600.0  # NTP step, zero monotonic time
+        sh._enforce_caps()
+        st = sh.stats()
+        assert st["dropped"]["backlog"] == 0
+        assert st["backlog_batches"] == 1
+        assert st["backlog_age_s"] == 0.0  # fenced, not 3600
+        sh.close()
+
+    def test_slow_drain_backlog_age_is_true_enqueue_age(self, tmp_path):
+        # A draining backlog's head age must be the time since ENQUEUE,
+        # not since the batch became head: a receiver accepting slower
+        # than the batch rate would otherwise report a perpetual ~0 age
+        # and the age cap/alert would never see the growing staleness.
+        clock = {"wall": 1000.0, "mono": 500.0}
+        sh = make_shipper(tmp_path, CollectingSend(fail_until=10**9),
+                          wallclock=lambda: clock["wall"],
+                          clock=lambda: clock["mono"])
+        sh._write_snapshot(up_snap(1000.0))
+        clock["wall"] += 300.0
+        clock["mono"] += 300.0
+        sh._write_snapshot(up_snap(1300.0))
+        sh._peek_meta()  # a drain step re-reads the head: age must hold
+        assert sh.backlog_age_s() == pytest.approx(300.0)
+        sh.close()
+
+    def test_forward_step_sheds_only_genuinely_over_age(self, tmp_path):
+        # The age-cap SCAN is fenced like the trigger: with a genuinely
+        # over-age head AND a +1 h wall step, only the over-age prefix
+        # drops — never the fresh batches behind it.
+        clock = {"wall": 1000.0, "mono": 500.0}
+        sh = make_shipper(tmp_path, CollectingSend(fail_until=10**9),
+                          max_backlog_age_s=50.0,
+                          wallclock=lambda: clock["wall"],
+                          clock=lambda: clock["mono"])
+        sh._write_snapshot(up_snap(1000.0))
+        clock["wall"] += 55.0
+        clock["mono"] += 55.0
+        sh._write_snapshot(up_snap(1055.0))   # fresh batch
+        clock["wall"] += 3600.0               # NTP step, no real time
+        sh._peek_meta()
+        sh._enforce_caps()
+        st = sh.stats()
+        assert st["dropped"]["backlog"] == 1  # only the 55 s-old head
+        assert st["backlog_batches"] == 1
+        sh.close()
+
+    def test_backward_wall_step_does_not_stall_shipping(self, tmp_path):
+        # A backward step must not park the interval gate: without the
+        # clamp, `wall - last_batch_wall` stays negative until the clock
+        # catches back up and egress silently stops for the step width.
+        send = CollectingSend()
+        sh = make_shipper(tmp_path, send, interval_s=1.0)
+        sh._write_snapshot(up_snap(1000.0))
+        sh._write_snapshot(up_snap(940.0))   # clock stepped -60 s
+        sh._write_snapshot(up_snap(941.5))   # next poll on the new timeline
+        heads = []
+        while True:
+            p = sh.buffer.peek()
+            if p is None:
+                break
+            head, _ = parse_batch(p)
+            heads.append(head["wall"])
+            sh.buffer.ack()
+        # The 941.5 batch shipped (interval met on the NEW timeline); the
+        # 940.0 one re-anchored the gate and was deliberately skipped.
+        assert heads == [1000.0, 941.5]
         sh.close()
 
     def test_half_open_probe_on_corrupt_head_never_wedges(self, tmp_path):

@@ -1,0 +1,897 @@
+"""Resource-pressure governor: ladders, shed hooks, admission control,
+full-disk boots, and the ENOSPC-mid-append contract (ISSUE 10)."""
+
+from __future__ import annotations
+
+import errno
+import http.client
+import os
+import random
+import time
+
+import pytest
+
+from tpu_pod_exporter import persist as persist_mod
+from tpu_pod_exporter.history import HistoryStore
+from tpu_pod_exporter.metrics import SnapshotBuilder, SnapshotStore
+from tpu_pod_exporter.persist import StatePersister, WalBuffer
+from tpu_pod_exporter.pressure import (
+    PressureGovernor,
+    dir_usage_bytes,
+    is_disk_full_error,
+    pressure_status_summary,
+    reclaim_tmp_files,
+)
+from tpu_pod_exporter.server import MetricsServer
+from tpu_pod_exporter.trace import PollTrace, TraceStore
+
+
+def put_body(store: SnapshotStore, n: int = 2000) -> None:
+    b = SnapshotBuilder()
+    from tpu_pod_exporter.metrics import schema
+
+    b.declare(schema.TPU_EXPORTER_UP)
+    b.add(schema.TPU_EXPORTER_UP, 1.0)
+    store.swap(b.build(timestamp=time.time()))
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+
+# ------------------------------------------------------------ governor core
+
+
+class TestGovernor:
+    def make(self, **kw):
+        clock = FakeClock()
+        kw.setdefault("check_interval_s", 0.01)
+        kw.setdefault("hysteresis_s", 10.0)
+        gov = PressureGovernor(clock=clock, wallclock=clock, **kw)
+        return gov, clock
+
+    def test_sheds_one_rung_per_tick_in_order(self):
+        gov, clock = self.make(memory_budget_bytes=100)
+        usage = {"n": 1000}
+        gov.register_memory_component("x", lambda: usage["n"])
+        order: list[str] = []
+        for name in ("a", "b", "c"):
+            gov.add_memory_rung(name, lambda n=name: order.append(n),
+                                lambda n=name: order.append(f"-{n}"))
+        gov.tick()
+        assert order == ["a"]
+        gov.tick()
+        gov.tick()
+        gov.tick()  # ladder exhausted: no further sheds
+        assert order == ["a", "b", "c"]
+        st = gov.stats()["memory"]
+        assert st["level"] == 3 and st["sheds"] == 3
+        assert st["rung"] == "c"
+
+    def test_recovery_needs_hysteresis_and_steps_rung_by_rung(self):
+        gov, clock = self.make(memory_budget_bytes=100, hysteresis_s=5.0)
+        usage = {"n": 1000}
+        gov.register_memory_component("x", lambda: usage["n"])
+        order: list[str] = []
+        gov.add_memory_rung("a", lambda: order.append("a"),
+                            lambda: order.append("-a"))
+        gov.add_memory_rung("b", lambda: order.append("b"),
+                            lambda: order.append("-b"))
+        gov.tick()
+        gov.tick()
+        assert order == ["a", "b"]
+        usage["n"] = 10  # pressure gone, well under recover_frac
+        gov.tick()       # starts the quiet window, no release yet
+        assert order == ["a", "b"]
+        clock.t += 3.0
+        gov.tick()       # still inside hysteresis
+        assert order == ["a", "b"]
+        clock.t += 3.0
+        gov.tick()       # one rung released...
+        assert order == ["a", "b", "-b"]
+        gov.tick()       # ...and the NEXT needs its own quiet window
+        assert order == ["a", "b", "-b"]
+        clock.t += 6.0
+        gov.tick()
+        assert order == ["a", "b", "-b", "-a"]
+        st = gov.stats()["memory"]
+        assert st["level"] == 0 and st["recovers"] == 2
+
+    def test_usage_above_recover_frac_blocks_recovery(self):
+        gov, clock = self.make(memory_budget_bytes=100, hysteresis_s=1.0)
+        usage = {"n": 1000}
+        gov.register_memory_component("x", lambda: usage["n"])
+        released = []
+        gov.add_memory_rung("a", lambda: None, lambda: released.append(1))
+        gov.tick()
+        usage["n"] = 95  # under budget, but above 0.85 * budget
+        for _ in range(5):
+            clock.t += 5.0
+            gov.tick()
+        assert not released  # hysteresis band holds the rung
+
+    def test_enospc_report_sheds_without_a_budget(self):
+        gov, clock = self.make()  # no budgets at all
+        shed = []
+        gov.add_disk_rung("a", lambda: shed.append(1), lambda: shed.append(-1))
+        assert gov.report_io_error(OSError(errno.ENOSPC, "full"))
+        assert not gov.report_io_error(OSError(errno.EIO, "flaky"))
+        assert not gov.report_io_error(ValueError("nope"))
+        gov.tick()
+        assert shed == [1]
+        # The fault window expires -> recovery (budget 0 = fault-only).
+        clock.t += 120.0
+        gov.tick()
+        clock.t += 120.0
+        gov.tick()
+        assert shed == [1, -1]
+
+    def test_broken_rung_does_not_kill_the_governor(self):
+        gov, _clock = self.make(memory_budget_bytes=1)
+        gov.register_memory_component("x", lambda: 1000)
+
+        def boom() -> None:
+            raise RuntimeError("rung exploded")
+
+        gov.add_memory_rung("a", boom, boom)
+        gov.tick()  # must not raise
+        assert gov.stats()["memory"]["level"] == 1
+
+    def test_emit_matches_stats(self):
+        gov, _clock = self.make(memory_budget_bytes=100)
+        gov.register_memory_component("x", lambda: 500)
+        gov.add_memory_rung("a", lambda: None, lambda: None)
+        gov.tick()
+        b = SnapshotBuilder()
+        gov.emit(b)
+        body = b.build(timestamp=time.time()).encode().decode()
+        assert 'tpu_exporter_pressure_state{resource="memory"} 1' in body
+        assert 'tpu_exporter_pressure_state{resource="disk"} 0' in body
+        assert ('tpu_exporter_pressure_transitions_total'
+                '{resource="memory",direction="shed"} 1') in body
+        assert ('tpu_exporter_pressure_budget_bytes{resource="memory"} 100'
+                in body)
+
+    def test_sidecar_roundtrip_and_status_line(self, tmp_path):
+        gov = PressureGovernor(memory_budget_bytes=100,
+                               sidecar_dir=str(tmp_path))
+        gov.register_memory_component("x", lambda: 500)
+        gov.add_memory_rung("cache_off", lambda: None, lambda: None)
+        gov.tick()
+        doc = pressure_status_summary(str(tmp_path))
+        assert doc is not None
+        assert doc["memory"]["level"] == 1
+        assert doc["memory"]["rung"] == "cache_off"
+        from tpu_pod_exporter.status import pressure_line
+
+        line = pressure_line(str(tmp_path))
+        assert line is not None and "memory rung 1 (cache_off)" in line
+        assert pressure_status_summary(str(tmp_path / "nope")) is None
+
+
+class TestTmpReclaim:
+    def test_reclaims_orphans_keeps_fresh(self, tmp_path):
+        old = tmp_path / "snapshot.bin.tmp"
+        old.write_bytes(b"x" * 10)
+        os.utime(old, (time.time() - 3600, time.time() - 3600))
+        fresh = tmp_path / "live.tmp"
+        fresh.write_bytes(b"y")
+        keep = tmp_path / "snapshot.bin"
+        keep.write_bytes(b"z")
+        n = reclaim_tmp_files([str(tmp_path)], min_age_s=60.0)
+        assert n == 1
+        assert not old.exists() and fresh.exists() and keep.exists()
+        # Boot shape: age 0 reclaims everything .tmp.
+        assert reclaim_tmp_files([str(tmp_path)], min_age_s=0.0) == 1
+        assert not fresh.exists() and keep.exists()
+
+    def test_missing_dir_is_quiet(self):
+        assert reclaim_tmp_files(["/nonexistent/nowhere", ""]) == 0
+
+    def test_dir_usage(self, tmp_path):
+        (tmp_path / "a").write_bytes(b"x" * 100)
+        (tmp_path / "b").write_bytes(b"y" * 50)
+        assert dir_usage_bytes(str(tmp_path)) == 150
+        assert dir_usage_bytes(str(tmp_path / "nope")) == 0
+
+    def test_is_disk_full_error(self):
+        assert is_disk_full_error(OSError(errno.ENOSPC, "x"))
+        assert is_disk_full_error(OSError(errno.EDQUOT, "x"))
+        assert not is_disk_full_error(OSError(errno.EIO, "x"))
+        assert not is_disk_full_error(RuntimeError("x"))
+
+
+# -------------------------------------------------------- persist shed hooks
+
+
+def make_persister(tmp_path, **kw):
+    history = HistoryStore(capacity=8, max_series=64, retention_s=0.0,
+                           tiers=())
+    kw.setdefault("snapshot_interval_s", 0.0)
+    kw.setdefault("fsync_interval_s", 0.0)
+    p = StatePersister(str(tmp_path), history=history, **kw)
+    return p
+
+
+def snap_with(up: float = 1.0, ts: float = 100.0):
+    from tpu_pod_exporter.metrics import schema
+
+    b = SnapshotBuilder()
+    b.declare(schema.TPU_EXPORTER_UP)
+    b.add(schema.TPU_EXPORTER_UP, up)
+    return b.build(timestamp=ts)
+
+
+class TestPersistShed:
+    def test_wal_stride_thins_and_counts_shed(self, tmp_path):
+        p = make_persister(tmp_path)
+        p.set_wal_stride(4)
+        for i in range(8):
+            p._write_samples(snap_with(ts=100.0 + i))
+        st = p.stats()
+        assert st["dropped_by_reason"]["shed"] == 6  # 2 of 8 written
+        assert st["wal_stride"] == 4
+        p.set_wal_stride(1)
+        p._write_samples(snap_with(ts=200.0))
+        assert p.stats()["dropped_by_reason"]["shed"] == 6
+
+    def test_wal_off_sheds_everything(self, tmp_path):
+        p = make_persister(tmp_path)
+        p.set_wal_enabled(False)
+        for i in range(3):
+            p._write_samples(snap_with(ts=100.0 + i))
+        st = p.stats()
+        assert st["dropped_by_reason"]["shed"] == 3
+        assert st["wal_records"] == 0
+
+    def test_snapshot_factor_stretches_interval(self, tmp_path):
+        clock = FakeClock()
+        p = make_persister(tmp_path, snapshot_interval_s=10.0, clock=clock,
+                           wallclock=clock)
+        p._last_rotate = clock.t
+        p.set_snapshot_interval_factor(2.0)
+        clock.t += 15.0  # past the base interval, inside the doubled one
+        p._maybe_rotate()
+        assert p.stats()["snapshots"] == 0
+        clock.t += 6.0
+        p._maybe_rotate()
+        assert p.stats()["snapshots"] == 1
+
+    def test_checkpoint_failure_retries_on_short_cadence(self, tmp_path,
+                                                         monkeypatch):
+        clock = FakeClock()
+        p = make_persister(tmp_path, snapshot_interval_s=100.0, clock=clock,
+                           wallclock=clock)
+        p._last_rotate = clock.t
+        calls = {"n": 0}
+        real = persist_mod.atomic_write
+
+        def failing(path, data):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                raise OSError(errno.ENOSPC, "disk full")
+            real(path, data)
+
+        monkeypatch.setattr(persist_mod, "atomic_write", failing)
+        clock.t += 101.0
+        p._maybe_rotate()  # fails, counted as disk_full, armed for retry
+        st = p.stats()
+        assert st["snapshots"] == 0
+        assert st["errors_by_reason"]["disk_full"] == 1
+        clock.t += 2.0
+        p._maybe_rotate()  # inside SNAPSHOT_RETRY_S: no attempt yet
+        assert calls["n"] == 1
+        clock.t += StatePersister.SNAPSHOT_RETRY_S
+        p._maybe_rotate()  # retry succeeds WITHOUT waiting out 100 s
+        assert p.stats()["snapshots"] == 1
+
+    def test_enospc_reports_to_pressure_hook(self, tmp_path):
+        p = make_persister(tmp_path)
+        seen: list[BaseException] = []
+        p.set_pressure_hook(lambda e: bool(seen.append(e)) or True)
+        p._count_error("boom: %s", "x", exc=OSError(errno.ENOSPC, "full"))
+        assert len(seen) == 1
+        st = p.stats()
+        assert st["errors_by_reason"]["disk_full"] == 1
+        assert st["errors_by_reason"]["io"] == 0
+
+    def test_boot_reclaims_orphan_tmp(self, tmp_path):
+        orphan = tmp_path / "snapshot.bin.tmp"
+        orphan.write_bytes(b"partial checkpoint")
+        p = make_persister(tmp_path)
+        p.load()
+        assert not orphan.exists()
+
+
+# --------------------------------------------------- ENOSPC mid-append fuzz
+
+
+class TestWalBufferEnospcFuzz:
+    def test_seeded_enospc_mid_append_keeps_the_contract(self, tmp_path,
+                                                         monkeypatch):
+        """ENOSPC striking MID-append (a torn partial record on disk) must
+        seal the segment: every record appended BEFORE the tear stays
+        deliverable, every record after lands in a fresh segment, nothing
+        acked is ever re-delivered across a reopen — 25 seeded trials."""
+        real_append = persist_mod.append_record
+
+        for trial in range(25):
+            rng = random.Random(1000 + trial)
+            d = tmp_path / f"t{trial}"
+            buf = WalBuffer(str(d), fsync=False)
+            buf.open()
+            n = 20
+            fault_at = rng.randrange(2, n - 2)
+            cut_header = rng.random() < 0.5
+
+            def torn_append(f, payload, _fa=fault_at, _ch=cut_header):
+                idx = int(payload.decode().split(":")[0])
+                if idx == _fa:
+                    # Write PART of the record, then fail — the torn-tail
+                    # shape a real ENOSPC leaves behind.
+                    hdr = persist_mod._HDR.pack(
+                        len(payload), 0xDEAD)
+                    f.write(hdr if _ch else hdr + payload[: len(payload) // 2])
+                    raise OSError(errno.ENOSPC, "No space left on device")
+                return real_append(f, payload)
+
+            monkeypatch.setattr(persist_mod, "append_record", torn_append)
+            dropped = []
+            for i in range(n):
+                payload = f"{i}:{'x' * rng.randrange(5, 40)}".encode()
+                try:
+                    buf.append(payload)
+                except OSError:
+                    dropped.append(i)
+            monkeypatch.setattr(persist_mod, "append_record", real_append)
+            assert dropped == [fault_at]
+            # Every non-dropped record is deliverable, in order.
+            delivered = []
+            k = rng.randrange(1, n - 2)  # ack a prefix, then "crash"
+            for _ in range(k):
+                payload = buf.peek()
+                assert payload is not None
+                delivered.append(int(payload.decode().split(":")[0]))
+                buf.ack()
+            buf.close()
+            buf2 = WalBuffer(str(d), fsync=False)
+            info = buf2.open()
+            resumed = []
+            while True:
+                payload = buf2.peek()
+                if payload is None:
+                    break
+                resumed.append(int(payload.decode().split(":")[0]))
+                buf2.ack()
+            expect = [i for i in range(n) if i != fault_at]
+            assert delivered + resumed == expect, (
+                f"trial {trial}: {delivered} + {resumed} != {expect} "
+                f"(fault at {fault_at}, open info {info})"
+            )
+            assert not set(delivered) & set(resumed)  # no acked re-send
+            buf2.close()
+
+
+# ------------------------------------------------------- boot on a full disk
+
+
+BAD_DIR = "/proc/1/nonexistent"
+
+
+def _bad_dir_is_bad() -> bool:
+    try:
+        os.makedirs(BAD_DIR, exist_ok=True)
+        return False
+    except OSError:
+        return True
+
+
+class TestBootOnFullDisk:
+    """Every --state-dir / egress-dir consumer must START SERVING with
+    persistence shed when the disk refuses everything — never crash-loop
+    (the hopeless-dir flavor; the mid-flight ENOSPC flavor is covered by
+    the persist/egress error paths above)."""
+
+    pytestmark = pytest.mark.skipif(
+        not _bad_dir_is_bad(), reason="no unwritable directory available"
+    )
+
+    def test_exporter_app_serves(self):
+        from tpu_pod_exporter.app import ExporterApp
+        from tpu_pod_exporter.config import ExporterConfig
+
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", interval_s=0.2, backend="fake",
+            fake_chips=2, attribution="none",
+            state_dir=os.path.join(BAD_DIR, "state"),
+            egress_url="http://127.0.0.1:9/unreachable",
+            egress_dir=os.path.join(BAD_DIR, "egress"),
+            state_max_disk_mb=1.0,
+            log_level="error",
+        )
+        app = ExporterApp(cfg)
+        try:
+            app.start()
+            conn = http.client.HTTPConnection("127.0.0.1", app.port,
+                                              timeout=5)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read().decode()
+            assert resp.status == 200
+            assert "tpu_exporter_up 1" in body
+            conn.close()
+        finally:
+            app.stop()
+
+    def test_persister_load_cold_starts(self):
+        p = StatePersister(os.path.join(BAD_DIR, "state"))
+        rs = p.load()
+        assert not rs.restored and rs.errors
+        p.start()  # no thread on a dead dir; on_poll is a no-op
+        assert p.on_poll(snap_with()) == 0
+        p.close()
+
+    def test_shipper_load_degrades(self):
+        from tpu_pod_exporter.egress import RemoteWriteShipper
+
+        sh = RemoteWriteShipper("http://127.0.0.1:9/w",
+                                os.path.join(BAD_DIR, "egress"))
+        info = sh.load()  # must not raise
+        assert info["errors"]
+        sh.close()
+
+    def test_flat_aggregator_state_files_tolerate(self):
+        from tpu_pod_exporter.persist import BreakerStateFile, ShardMapFile
+
+        bf = BreakerStateFile(os.path.join(BAD_DIR, "breakers.json"))
+        assert bf.load() == {}
+        bf.save({"t": {"state": "open"}})  # logs, never raises
+        sf = ShardMapFile(os.path.join(BAD_DIR, "shardmap.json"))
+        assert sf.load() == {}
+        sf.save({"shards": 2})
+
+    def test_leaf_and_root_serve_with_dead_state_dirs(self):
+        from tpu_pod_exporter.persist import BreakerStateFile, ShardMapFile
+        from tpu_pod_exporter.shard import (
+            RootAggregator,
+            ShardMap,
+            default_shards,
+        )
+
+        smap = ShardMap(default_shards(2))
+        store = SnapshotStore()
+        root = RootAggregator(
+            {"s0": ("127.0.0.1:9",)},  # unreachable leaf: degrades, fine
+            store,
+            timeout_s=0.2,
+            shard_map=smap,
+            shard_map_store=ShardMapFile(
+                os.path.join(BAD_DIR, "root-map.json")),
+            breaker_store=BreakerStateFile(
+                os.path.join(BAD_DIR, "root-breakers.json")),
+        )
+        root.poll_once()  # must not raise; publishes a (degraded) round
+        body = store.current().encode().decode()
+        assert "tpu_root_leaf_up" in body
+        root.close()
+
+
+# --------------------------------------------------------- admission control
+
+
+class TestAdmissionControl:
+    def test_connection_cap_rejects_with_429_health_exempt(self):
+        store = SnapshotStore()
+        put_body(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0,
+                               max_open_connections=1)
+        server.start()
+        try:
+            c1 = http.client.HTTPConnection("127.0.0.1", server.port,
+                                            timeout=5)
+            c1.request("GET", "/metrics")
+            r1 = c1.getresponse()
+            r1.read()
+            assert r1.status == 200  # admitted, slot held (keep-alive)
+
+            c2 = http.client.HTTPConnection("127.0.0.1", server.port,
+                                            timeout=5)
+            c2.request("GET", "/metrics")
+            r2 = c2.getresponse()
+            body = r2.read()
+            assert r2.status == 429
+            assert r2.headers["Retry-After"] == "1"
+            assert b"connection limit" in body
+            c2.close()
+
+            # Probe paths answer even over the cap (kubelet must never be
+            # 429'd into restarting the pod by a storm).
+            c3 = http.client.HTTPConnection("127.0.0.1", server.port,
+                                            timeout=5)
+            c3.request("GET", "/healthz")
+            r3 = c3.getresponse()
+            r3.read()
+            assert r3.status == 200
+            c3.close()
+
+            assert server.scrape_rejects["connections"] >= 1
+            assert server.conn_stats["peak"] == 1
+
+            # Releasing the incumbent frees the slot.
+            c1.close()
+            deadline = time.monotonic() + 5.0
+            ok = False
+            while time.monotonic() < deadline:
+                c4 = http.client.HTTPConnection("127.0.0.1", server.port,
+                                                timeout=5)
+                c4.request("GET", "/metrics")
+                r4 = c4.getresponse()
+                r4.read()
+                c4.close()
+                if r4.status == 200:
+                    ok = True
+                    break
+                time.sleep(0.05)
+            assert ok
+        finally:
+            server.stop()
+
+    def test_per_client_cap_rejects_and_counts(self):
+        store = SnapshotStore()
+        put_body(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0,
+                               max_requests_per_client=2)
+        server.start()
+        try:
+            handler = server._httpd.RequestHandlerClass
+            # Saturate the client's budget deterministically (the counter
+            # the admission check reads).
+            with handler.client_lock:
+                handler.client_active["127.0.0.1"] = 2
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=5)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            body = resp.read()
+            assert resp.status == 429
+            assert b"per-client" in body
+            conn.close()
+            # Health stays exempt.
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=5)
+            conn.request("GET", "/readyz")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status in (200, 503)  # not 429
+            conn.close()
+            assert server.scrape_rejects["client"] >= 1
+            with handler.client_lock:
+                handler.client_active.clear()
+            conn = http.client.HTTPConnection("127.0.0.1", server.port,
+                                              timeout=5)
+            conn.request("GET", "/metrics")
+            resp = conn.getresponse()
+            resp.read()
+            assert resp.status == 200
+            conn.close()
+        finally:
+            server.stop()
+
+    def test_caps_default_off(self):
+        store = SnapshotStore()
+        put_body(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            conns = []
+            for _ in range(6):
+                c = http.client.HTTPConnection("127.0.0.1", server.port,
+                                               timeout=5)
+                c.request("GET", "/metrics")
+                r = c.getresponse()
+                r.read()
+                assert r.status == 200
+                conns.append(c)
+            for c in conns:
+                c.close()
+            assert server.scrape_rejects["connections"] == 0
+            assert server.scrape_rejects["client"] == 0
+        finally:
+            server.stop()
+
+
+# ------------------------------------------------------- component shed hooks
+
+
+class TestHistoryCapacityCut:
+    def test_cut_keeps_newest_and_grows_back(self):
+        h = HistoryStore(capacity=16, max_series=32, retention_s=0.0,
+                         tiers=())
+        for i in range(16):
+            h.append("tpu_hbm_used_bytes", {"chip_id": "0"}, float(i),
+                     t_mono=float(i), t_wall=1000.0 + i)
+        h.set_capacity(4)
+        rows = h.query_range("tpu_hbm_used_bytes", {},
+                             start=0.0, end=2000.0)
+        vals = [v for _t, v in rows[0]["values"]]
+        assert vals == [12.0, 13.0, 14.0, 15.0]  # newest kept
+        assert h.stats()["memory_bytes"] == 1 * 4 * 24
+        # Appends keep flowing after the rebuild (layout cache intact).
+        h.append("tpu_hbm_used_bytes", {"chip_id": "0"}, 99.0,
+                 t_mono=20.0, t_wall=1020.0)
+        rows = h.query_range("tpu_hbm_used_bytes", {},
+                             start=0.0, end=2000.0)
+        vals = [v for _t, v in rows[0]["values"]]
+        assert vals == [13.0, 14.0, 15.0, 99.0]
+        # Growing back preserves what survived.
+        h.set_capacity(16)
+        rows = h.query_range("tpu_hbm_used_bytes", {},
+                             start=0.0, end=2000.0)
+        vals = [v for _t, v in rows[0]["values"]]
+        assert vals == [13.0, 14.0, 15.0, 99.0]
+
+    def test_cut_through_append_snapshot_fast_path(self):
+        h = HistoryStore(capacity=8, max_series=32, retention_s=0.0,
+                         tiers=())
+        from tpu_pod_exporter.metrics import schema
+
+        def snap(i: float):
+            b = SnapshotBuilder()
+            b.declare(schema.TPU_EXPORTER_UP)
+            b.add(schema.TPU_EXPORTER_UP, i)
+            return b.build(timestamp=1000.0 + i)
+
+        for i in range(6):
+            h.append_snapshot(snap(float(i)), now_mono=float(i),
+                              now_wall=1000.0 + i)
+        h.set_capacity(3)
+        for i in range(6, 9):
+            h.append_snapshot(snap(float(i)), now_mono=float(i),
+                              now_wall=1000.0 + i)
+        rows = h.query_range("tpu_exporter_up", {}, start=0.0, end=2000.0)
+        vals = [v for _t, v in rows[0]["values"]]
+        assert vals == [6.0, 7.0, 8.0]
+
+
+class TestTraceRingShed:
+    def make_trace(self):
+        tr = PollTrace("poll", time.monotonic, time.time)
+        tr.begin("device_read")
+        tr.end("ok")
+        return tr
+
+    def test_halving_keeps_newest_and_accounts(self):
+        ts = TraceStore(max_traces=8)
+        traces = [self.make_trace() for _ in range(8)]
+        for tr in traces:
+            ts.append(tr)
+        before = ts.memory_bytes()
+        ts.set_max_traces(4)
+        assert ts.max_traces == 4
+        assert ts.last(8) == traces[4:]
+        assert ts.memory_bytes() == before // 2
+        ts.set_max_traces(8)  # grow back: bound restored, evictions stay
+        assert len(ts.last(8)) == 4
+        ts.append(self.make_trace())
+        assert len(ts.last(8)) == 5
+
+
+class TestFleetCacheBytes:
+    def test_bytes_clear_disable(self):
+        from tpu_pod_exporter.fleet import _QueryCache
+
+        c = _QueryCache(4)
+        env = {"status": "ok", "data": ["x" * 100]}
+        c.put(("a",), env)
+        assert c.bytes() >= 100
+        c.put(("a",), env)  # re-put same key: no double count
+        one = c.bytes()
+        c.put(("b",), env)
+        assert c.bytes() == 2 * one
+        for i in range(10):
+            c.put((f"k{i}",), env)
+        assert len(c) == 4 and c.bytes() == 4 * one  # LRU eviction accounted
+        c.set_enabled(False)
+        assert c.bytes() == 0 and len(c) == 0
+        c.put(("z",), env)  # disabled: no-op
+        assert len(c) == 0
+        c.set_enabled(True)
+        c.put(("z",), env)
+        assert len(c) == 1
+
+
+# ------------------------------------------------------ exporter exposition
+
+
+class TestExpositionSurface:
+    def test_collector_emits_pressure_and_reason_labels(self, tmp_path):
+        from tpu_pod_exporter.attribution.fake import FakeAttribution
+        from tpu_pod_exporter.backend.fake import FakeBackend
+        from tpu_pod_exporter.collector import Collector
+
+        persister = StatePersister(str(tmp_path))
+        gov = PressureGovernor(disk_budget_bytes=1 << 20)
+        gov.add_disk_path(str(tmp_path))
+        store = SnapshotStore()
+        collector = Collector(
+            FakeBackend(chips=2), FakeAttribution(), store,
+            persister=persister, governor=gov,
+        )
+        collector.poll_once()
+        body = store.current().encode().decode()
+        assert 'tpu_exporter_pressure_state{resource="disk"} 0' in body
+        assert 'tpu_exporter_pressure_budget_bytes{resource="disk"}' in body
+        assert ('tpu_exporter_persist_dropped_total{reason="queue"} 0'
+                in body)
+        assert ('tpu_exporter_persist_dropped_total{reason="disk_full"} 0'
+                in body)
+        assert ('tpu_exporter_persist_errors_total{reason="disk_full"} 0'
+                in body)
+        collector.close()
+
+
+# ------------------------------------------------------------- scenario DSL
+
+
+class TestScenarioDsl:
+    def test_new_kinds_parse(self):
+        from tpu_pod_exporter.scenario import parse_event, parse_scenario
+
+        ev = parse_event("disk_full()@3+4")
+        assert ev.kind == "disk_full" and ev.duration == 4
+        ev = parse_event("mem_pressure()@2")
+        assert ev.kind == "mem_pressure" and ev.duration == 1
+        ev = parse_event("scrape_storm(120)@3+2")
+        assert ev.kind == "scrape_storm" and ev.count == 120
+        ev = parse_event("clock_step(-45)@2")
+        assert ev.kind == "clock_step" and ev.step_s == -45.0
+        ev = parse_event("clock_step(+3600)@1")
+        assert ev.step_s == 3600.0
+        evs = parse_scenario("clock_step(-45)@2; disk_full()@3+4")
+        assert [e.kind for e in evs] == ["clock_step", "disk_full"]
+
+    def test_new_kind_errors_are_actionable(self):
+        from tpu_pod_exporter.scenario import parse_event
+
+        with pytest.raises(ValueError, match="takes no arguments"):
+            parse_event("disk_full(3)@1")
+        with pytest.raises(ValueError, match="takes no arguments"):
+            parse_event("mem_pressure(x)@1")
+        with pytest.raises(ValueError, match="connection count"):
+            parse_event("scrape_storm(zero)@1")
+        with pytest.raises(ValueError, match="must be >= 1"):
+            parse_event("scrape_storm(0)@1")
+        with pytest.raises(ValueError, match="signed seconds"):
+            parse_event("clock_step(fast)@1")
+        with pytest.raises(ValueError, match="injects nothing"):
+            parse_event("clock_step(0)@1")
+        with pytest.raises(ValueError, match="instantaneous"):
+            parse_event("clock_step(-45)@1+3")
+
+    def test_named_pressure_scenarios_registered(self):
+        from tpu_pod_exporter.scenario import SCENARIOS
+
+        for name in ("disk_full", "mem_pressure", "scrape_storm"):
+            assert name in SCENARIOS
+            SCENARIOS[name].events()  # timelines parse
+
+
+# ---------------------------------------------------------- chaos injectors
+
+
+class TestHostChaos:
+    def test_clock_stepper(self):
+        c = FakeClock(1000.0)
+        from tpu_pod_exporter.chaos import ClockStepper
+
+        stepped = ClockStepper(real=c)
+        assert stepped() == 1000.0
+        stepped.step(-45.0)
+        assert stepped() == 955.0
+        stepped.step(+100.0)
+        assert stepped() == 1055.0
+        assert stepped.steps == [-45.0, 100.0]
+
+    def test_memory_hog(self):
+        from tpu_pod_exporter.chaos import MemoryHog
+
+        hog = MemoryHog()
+        hog.hold(3 << 20)
+        assert hog.held_bytes() == 3 << 20
+        hog.release()
+        assert hog.held_bytes() == 0
+
+    def test_scrape_storm_against_real_server(self):
+        store = SnapshotStore()
+        put_body(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0,
+                               max_open_connections=2)
+        server.start()
+        from tpu_pod_exporter.chaos import ScrapeStorm
+
+        storm = ScrapeStorm("127.0.0.1", server.port, conns=6,
+                            pause_s=0.01, reject_pause_s=0.05)
+        try:
+            storm.start()
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                st = storm.stats()
+                if st["served"] > 0 and st["rejected"] > 0:
+                    break
+                time.sleep(0.05)
+            st = storm.stats()
+            assert st["served"] > 0
+            assert st["rejected"] > 0
+            assert server.conn_stats["peak"] <= 2
+        finally:
+            storm.stop()
+            server.stop()
+
+
+# ----------------------------------------------------------- app-level wiring
+
+
+class TestAppWiring:
+    def test_governor_built_from_flags_and_debug_vars(self, tmp_path):
+        from tpu_pod_exporter.app import ExporterApp
+        from tpu_pod_exporter.config import ExporterConfig
+
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", interval_s=0.2, backend="fake",
+            fake_chips=2, attribution="none",
+            state_dir=str(tmp_path),
+            state_max_disk_mb=64.0, memory_budget_mb=64.0,
+            log_level="error",
+        )
+        app = ExporterApp(cfg)
+        try:
+            assert app.governor is not None
+            rungs = app.governor.stats()["disk"]["rungs"]
+            assert rungs == ["wal_coarse", "checkpoint_halved", "wal_off"]
+            mem_rungs = app.governor.stats()["memory"]["rungs"]
+            assert mem_rungs == ["trace_halved", "history_cut"]
+            dv = app._debug_vars()
+            assert "pressure" in dv
+            assert "memory_components" in dv["pressure"]
+            assert dv["connections"]["open"] >= 0
+        finally:
+            app.collector.close()
+
+    def test_no_budgets_no_state_no_governor(self):
+        from tpu_pod_exporter.app import ExporterApp
+        from tpu_pod_exporter.config import ExporterConfig
+
+        cfg = ExporterConfig(
+            port=0, host="127.0.0.1", backend="fake", fake_chips=1,
+            attribution="none", log_level="error",
+        )
+        app = ExporterApp(cfg)
+        try:
+            assert app.governor is None
+        finally:
+            app.collector.close()
+
+
+# ------------------------------------------------------- shard byte estimate
+
+
+class TestStaleViewBytes:
+    def test_estimate_and_shed(self):
+        from tpu_pod_exporter.shard import LeafView, RootAggregator
+
+        store = SnapshotStore()
+        root = RootAggregator({"s0": ("leaf:a",)}, store, timeout_s=0.1)
+        assert root.stale_view_bytes() == 0
+        view = LeafView(leaf="leaf:a", round_ts=1.0,
+                        target_up={"t1": 1.0, "t2": 0.0})
+        root._last_views["leaf:a"] = (view, 1.0)
+        est = root.stale_view_bytes()
+        assert est == 3 * 160  # 1 base + 2 target_up entries
+        assert root.debug_vars()["stale_view_bytes"] == est
+        assert root.shed_stale_views() == 1
+        assert root.stale_view_bytes() == 0
+        root.close()

@@ -369,6 +369,21 @@ class ExporterApp:
                 },
             )
             self.shipper.load()
+        # Resource-pressure governor (tpu_pod_exporter.pressure): explicit
+        # degradation ladders for disk (--state-max-disk-mb + reported
+        # ENOSPC over the persist WAL/checkpoint and egress send buffer)
+        # and memory (--memory-budget-mb over trace ring + history rings).
+        # None when nothing is governable; runs on its own thread so the
+        # poll loop never pays the disk-usage walk.
+        from tpu_pod_exporter.pressure import build_exporter_governor
+
+        self.governor = build_exporter_governor(
+            cfg,
+            persister=self.persister,
+            shipper=self.shipper,
+            history=self.history,
+            trace_store=self.trace,
+        )
         # Scrape-latency distribution: handler threads observe, the
         # collector emits it into each snapshot (one poll behind, which is
         # fine for a cumulative histogram).
@@ -392,6 +407,7 @@ class ExporterApp:
             tracer=self.tracer,
             persister=self.persister,
             shipper=self.shipper,
+            governor=self.governor,
             client_write_timeouts_fn=lambda: self.server.write_timeouts["total"],
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
@@ -414,6 +430,8 @@ class ExporterApp:
             ready_detail_fn=self._ready_detail,
             client_write_timeout_s=cfg.client_write_timeout_s,
             warm_fn=self._warm_state,
+            max_open_connections=cfg.max_open_connections,
+            max_requests_per_client=cfg.max_requests_per_client,
         )
 
     def _warm_state(self) -> dict | None:
@@ -537,7 +555,15 @@ class ExporterApp:
                 **self.shipper.stats(),
                 "dir": egress_dir_summary(self.cfg.egress_dir),
             }
+        if self.governor is not None:
+            out["pressure"] = {
+                **self.governor.stats(),
+                # The per-component byte breakdown the memory ladder's
+                # shed decision sums — same numbers, one source.
+                "memory_components": self.governor.memory_component_bytes(),
+            }
         out["client_write_timeouts"] = self.server.write_timeouts["total"]
+        out["connections"] = dict(self.server.conn_stats)
         if self.trace is not None:
             out["trace"] = self.trace.stats()
         if self.supervisors:
@@ -556,6 +582,8 @@ class ExporterApp:
         return self.server.port
 
     def start(self) -> None:
+        if self.governor is not None:
+            self.governor.start()
         if self.persister is not None:
             self.persister.start()
         if self.shipper is not None:
@@ -619,6 +647,8 @@ class ExporterApp:
             # process resumes them from the ack cursor (no drain wait — a
             # down receiver must not stall the SIGTERM grace period).
             self.shipper.close()
+        if self.governor is not None:
+            self.governor.close()
         if self.tracer is not None:
             self.tracer.close()
 

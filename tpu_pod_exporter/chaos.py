@@ -527,6 +527,168 @@ class PartitionedSend:
         return self._inner(url, body, headers, timeout_s)
 
 
+# --- Host-level chaos (resource-pressure drills) -----------------------------
+#
+# The pressure drills (tpu_pod_exporter.pressure, scenario kinds
+# ``disk_full`` / ``mem_pressure`` / ``scrape_storm`` / ``clock_step``)
+# need faults no wrapped poll source can model: the MACHINE misbehaving.
+# Like LeafKillHook, these are timeline-driven harness classes rather than
+# ``--chaos-spec`` rules — the scenario engine and ``make pressure-demo``
+# fire them at fixed round coordinates, deterministically.
+
+
+class ClockStepper:
+    """An injectable wall clock with a mutable offset — the ``clock_step``
+    fault. Components take it as their ``wallclock=`` callable; the drill
+    calls :meth:`step` mid-run and asserts the wall-time seams (egress
+    batch gating, backlog ages, staleness gauges) stay fenced: ages never
+    go negative, and a backward step never silently stops a pipeline."""
+
+    def __init__(self, base: "float | None" = None,
+                 real=time.time) -> None:
+        self._real = real
+        self._base = base
+        self.offset_s = 0.0
+        self.steps: list[float] = []
+
+    def step(self, seconds: float) -> None:
+        """Apply one NTP-shaped step (positive = forward)."""
+        self.offset_s += seconds
+        self.steps.append(seconds)
+        log.warning("chaos: wall clock stepped %+gs (offset now %+gs)",
+                    seconds, self.offset_s)
+
+    def __call__(self) -> float:
+        now = self._real() if self._base is None else self._base
+        return now + self.offset_s
+
+
+class MemoryHog:
+    """Holds real referenced memory (the ``mem_pressure`` fault's RSS
+    half): allocates touch-backed bytearrays so the drill's RSS assertions
+    measure genuine pages, not lazily-mapped zeros."""
+
+    def __init__(self) -> None:
+        self._blocks: list[bytearray] = []
+
+    def hold(self, n_bytes: int, block: int = 1 << 20) -> None:
+        remaining = n_bytes
+        while remaining > 0:
+            size = min(block, remaining)
+            buf = bytearray(size)
+            # Touch one byte per page so the kernel actually commits it.
+            for i in range(0, size, 4096):
+                buf[i] = 1
+            self._blocks.append(buf)
+            remaining -= size
+
+    def held_bytes(self) -> int:
+        return sum(len(b) for b in self._blocks)
+
+    def release(self) -> None:
+        self._blocks.clear()
+
+
+class ScrapeStorm:
+    """A misconfigured scrape fleet: N concurrent connections hammering
+    one URL in tight keep-alive loops — the admission-control drill's
+    storm half. Each worker binds its own loopback SOURCE address
+    (127.0.0.N pool) so the per-client-IP cap sees distinct clients from
+    the polite scraper sharing the same box."""
+
+    def __init__(self, host: str, port: int, path: str = "/metrics",
+                 conns: int = 100, source_ips: int = 8,
+                 pause_s: float = 0.0,
+                 reject_pause_s: float = 0.25) -> None:
+        self.host = host
+        self.port = port
+        self.path = path
+        self.conns = conns
+        self.source_ips = max(source_ips, 1)
+        # Per-request pause: 0 is a maximally-hostile tight loop; in-process
+        # drills pace slightly so the STORM THREADS' own GIL churn does not
+        # drown the polite-scraper measurement they run alongside.
+        self.pause_s = pause_s
+        # Back-off after a reject/reset before reconnecting: a fraction of
+        # the Retry-After: 1 the 429 carries (a storm of merely
+        # MISCONFIGURED scrapers retries eventually; one that ignores 429s
+        # entirely is modeled with 0 — at the cost of the client threads'
+        # own reconnect churn dominating an in-process measurement).
+        self.reject_pause_s = reject_pause_s
+        self.responses: dict[int, int] = {}   # status -> count
+        self.errors = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+    def _worker(self, idx: int) -> None:
+        import http.client
+
+        source = f"127.0.0.{2 + idx % self.source_ips}"
+        conn: http.client.HTTPConnection | None = None
+        while not self._stop.is_set():
+            try:
+                if conn is None:
+                    conn = http.client.HTTPConnection(
+                        self.host, self.port, timeout=5,
+                        source_address=(source, 0),
+                    )
+                conn.request("GET", self.path)
+                resp = conn.getresponse()
+                resp.read()
+                status = resp.status
+                if resp.headers.get("Connection") == "close":
+                    conn.close()
+                    conn = None
+                with self._lock:
+                    self.responses[status] = (
+                        self.responses.get(status, 0) + 1
+                    )
+                if status == 429 and self.reject_pause_s > 0:
+                    self._stop.wait(self.reject_pause_s)
+                elif self.pause_s > 0:
+                    self._stop.wait(self.pause_s)
+            except OSError:
+                with self._lock:
+                    self.errors += 1
+                if conn is not None:
+                    conn.close()
+                    conn = None
+                if self.reject_pause_s > 0:
+                    self._stop.wait(self.reject_pause_s)
+        if conn is not None:
+            conn.close()
+
+    def start(self) -> None:
+        if self._threads:
+            return
+        self._stop.clear()
+        self._threads = [
+            threading.Thread(
+                target=self._worker, args=(i,),
+                name=f"tpu-chaos-storm-{i}", daemon=True,
+            )
+            for i in range(self.conns)
+        ]
+        for t in self._threads:
+            t.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=timeout)
+        self._threads = []
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "responses": dict(self.responses),
+                "errors": self.errors,
+                "served": self.responses.get(200, 0),
+                "rejected": self.responses.get(429, 0),
+            }
+
+
 # --- Leaf chaos (sharded aggregation tree) -----------------------------------
 
 

@@ -114,6 +114,10 @@ class Collector:
         persister: "StatePersister | None" = None,
         # egress.RemoteWriteShipper; None = no push egress
         shipper: "RemoteWriteShipper | None" = None,
+        # pressure.PressureGovernor; None = no pressure surface. The
+        # governor runs its own check thread — the collector only emits
+        # its cached stats (never a disk walk on the poll thread).
+        governor: Any = None,
         # () -> int, from the HTTP server
         client_write_timeouts_fn: Callable[[], int] | None = None,
         clock: Callable[[], float] = time.monotonic,
@@ -173,6 +177,7 @@ class Collector:
         # every byte of network/disk I/O run on the shipper's own threads.
         self._shipper = shipper
         self._egress_s = 0.0
+        self._governor = governor
         self._client_write_timeouts_fn = client_write_timeouts_fn
         # Poll-phase faults repeat every interval (1 s) while a source is
         # down; rate-limit per fault key so logs show the fault, not 86k
@@ -947,10 +952,15 @@ class Collector:
                       float(ps["wal_records"]))
                 b.add(schema.TPU_EXPORTER_PERSIST_SNAPSHOTS_TOTAL,
                       float(ps["snapshots"]))
-                b.add(schema.TPU_EXPORTER_PERSIST_ERRORS_TOTAL,
-                      float(ps["errors"]))
-                b.add(schema.TPU_EXPORTER_PERSIST_DROPPED_TOTAL,
-                      float(ps["dropped"]))
+                # Reason-split error/drop counters: a full disk
+                # (reason="disk_full") and a flaky one (reason="io") page
+                # different playbooks — see the DiskPressure alert.
+                for reason, n in ps["errors_by_reason"].items():
+                    b.add(schema.TPU_EXPORTER_PERSIST_ERRORS_TOTAL,
+                          float(n), (reason,))
+                for reason, n in ps["dropped_by_reason"].items():
+                    b.add(schema.TPU_EXPORTER_PERSIST_DROPPED_TOTAL,
+                          float(n), (reason,))
                 b.add(schema.TPU_EXPORTER_PERSIST_FSYNC_SECONDS,
                       ps["last_fsync_s"])
                 if ps["last_snapshot_wall"] > 0:
@@ -967,6 +977,15 @@ class Collector:
             # is attached, read one poll behind like every other self-stat.
             try:
                 self._shipper.emit(b)
+            except Exception:  # noqa: BLE001 — accounting must never fail a poll
+                pass
+
+        if self._governor is not None:
+            # Conditional pressure surface (PRESSURE_SPECS): the ladder
+            # rung, bytes-vs-budget pair, and every shed/recover
+            # transition — the governor's cached numbers, no disk walk.
+            try:
+                self._governor.emit(b)
             except Exception:  # noqa: BLE001 — accounting must never fail a poll
                 pass
 

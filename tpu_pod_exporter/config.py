@@ -160,6 +160,28 @@ class ExporterConfig:
     egress_breaker_failures: int = 3
     egress_breaker_backoff_s: float = 1.0
     egress_breaker_backoff_max_s: float = 60.0
+    # Resource-pressure governor (tpu_pod_exporter.pressure): byte budget
+    # across --state-dir + --egress-dir. Past it (or on any reported
+    # ENOSPC/EDQUOT) the disk degradation ladder sheds by policy — WAL
+    # thinning, egress compaction/backlog trim, checkpoint halving, WAL
+    # off — and recovers rung by rung with hysteresis when space returns.
+    # 0 = no byte budget (the ladder still reacts to reported ENOSPC).
+    state_max_disk_mb: float = 0.0
+    # Memory budget over the byte-accounted in-memory components (history
+    # rings, trace ring, fleet query cache): past it the memory ladder
+    # sheds coarse-tiers-last — fleet cache off, trace ring halved, raw
+    # history rings cut. 0 disables the memory ladder entirely.
+    memory_budget_mb: float = 0.0
+    # Scrape-storm admission control: hard cap on concurrently OPEN
+    # connections (a keep-alive storm parks handler threads and eats file
+    # descriptors on a thread-per-connection server); over-cap connections
+    # get the pre-rendered 429 + Retry-After and are closed — except
+    # /healthz + /readyz, which always answer. 0 disables.
+    max_open_connections: int = 256
+    # Per-client-IP concurrent-request cap (one aggressive scraper must
+    # not monopolize the scrape/api fences for everyone else); same 429 +
+    # probe-path exemption. 0 disables.
+    max_requests_per_client: int = 32
     # Slow-client write defense: per-connection socket SEND timeout. A
     # scraper that stalls mid-body (stuck TCP peer, frozen pipe) gets its
     # connection dropped after this many seconds instead of pinning a

@@ -81,6 +81,15 @@ SEQ_HEADER = "X-Tpe-Egress-Seq"
 
 STATUS_NAME = "egress-status.json"
 
+# Segment size the send buffer rotates at while the disk-pressure ladder's
+# egress rung is applied: small segments mean acked records (the bulk of a
+# healthy shipper's on-disk footprint between 4 MB rotations) become
+# reclaimable within one ack sweep instead of one rotation — steady-state
+# disk then holds roughly one segment plus the pending backlog. Rotation
+# per ~8 KB is ~one extra open/close per batch at exposition batch sizes:
+# trivial, and only paid while the disk is actually under pressure.
+SHED_SEGMENT_BYTES = 8 << 10
+
 _U32 = struct.Struct("<I")
 
 
@@ -360,9 +369,14 @@ def parse_write_request(
 
 
 def frame_batch(seq: int, wall: float, kind: str, samples: int,
-                proto: bytes) -> bytes:
+                proto: bytes, mono: float = 0.0) -> bytes:
+    # ``mono`` is the writer's MONOTONIC clock at enqueue: meaningful only
+    # within the process that wrote it (seqs above the boot seq), where it
+    # gives an exact, NTP-step-immune batch age. Pre-restart batches age
+    # on their wall stamp instead (see RemoteWriteShipper._head_age).
     head = json.dumps(
-        {"seq": seq, "wall": wall, "kind": kind, "samples": samples}
+        {"seq": seq, "wall": wall, "kind": kind, "samples": samples,
+         "mono": mono}
     ).encode()
     return b"B" + _U32.pack(len(head)) + head + proto
 
@@ -503,8 +517,28 @@ class RemoteWriteShipper:
         self.full_sync_s = full_sync_s
         self._last_full_wall = 0.0
         self._seq = 0
-        # Sender-thread cache of the head batch's header (age accounting).
-        self._head_meta: tuple[int, float] | None = None  # (seq, wall)
+        # Sender-thread cache of the head batch's header (age accounting):
+        # (seq, wall stamp, monotonic ENQUEUE stamp from the frame header).
+        # Batches created by THIS process age on their enqueue-monotonic
+        # stamp — exact, and an NTP step (clock_step chaos) can neither
+        # inflate their age into an age-cap mass-drop nor hide a genuinely
+        # stale slowly-draining backlog; only batches restored from a
+        # pre-restart backlog age on wall time (their true age genuinely
+        # predates this process, and their mono stamp belongs to a dead
+        # clock).
+        self._head_meta: tuple[int, float, float] | None = None
+        self._boot_seq = 0  # seqs <= this predate this process (see load)
+        # Resource-pressure shed (tpu_pod_exporter.pressure, disk ladder
+        # rung "egress_compact"): under disk pressure the buffer rotates
+        # TINY segments — acked-but-unrotated bytes are the bulk of a
+        # healthy shipper's disk footprint, and small segments let the
+        # ack sweep reclaim them promptly (no data loss) — and the
+        # pending-backlog byte cap tightens (bounded, counted loss, only
+        # while the receiver is down). Flag flipped by the governor
+        # thread, read by the writer/sender threads.
+        self._disk_pressure = False
+        self._normal_segment_bytes = self.buffer.segment_max_bytes
+        self._pressure_hook: Callable[[BaseException], bool] | None = None
         self._stats_lock = threading.Lock()
         self._stats: dict[str, Any] = {
             "enqueued_batches": 0,
@@ -552,7 +586,8 @@ class RemoteWriteShipper:
                 head, _proto = parse_batch(payload)
                 with self._stats_lock:
                     self._head_meta = (int(head.get("seq", 0)),
-                                       float(head.get("wall", 0.0)))
+                                       float(head.get("wall", 0.0)),
+                                       float(head.get("mono", 0.0)))
                 break
             except (ValueError, KeyError, TypeError):
                 self.buffer.drop_oldest(1)
@@ -572,6 +607,10 @@ class RemoteWriteShipper:
         except Exception:  # noqa: BLE001 — a torn sidecar restarts from the scan
             pass
         self._seq = max_seq
+        # Everything at or below this seq predates this process: its age
+        # is genuinely its wall age. Batches ABOVE it age monotonically
+        # (clock-step fence — see _head_age).
+        self._boot_seq = max_seq
         corrupt = info.get("corrupt_segments", 0) + dropped
         if corrupt:
             with self._stats_lock:
@@ -640,6 +679,14 @@ class RemoteWriteShipper:
 
     def _write_snapshot(self, snap: "Snapshot") -> None:
         wall = float(getattr(snap, "poll_timestamp", snap.timestamp))
+        if wall < self._last_batch_wall:
+            # Wall clock stepped BACKWARDS (NTP correction): without this
+            # clamp the interval gate `wall - last < interval` stays
+            # negative until the clock catches back up and egress silently
+            # stops shipping for the whole step width. Resync the
+            # reference points to the new timeline instead.
+            self._last_batch_wall = wall
+            self._last_full_wall = min(self._last_full_wall, wall)
         if wall - self._last_batch_wall < self.interval_s:
             return
         current = self._extract(snap)
@@ -670,6 +717,7 @@ class RemoteWriteShipper:
         self._last_values = current
         if not batch:
             return
+        mono = self._clock()
         ts_ms = int(wall * 1000.0)
         series: list[tuple[list[tuple[str, str]], list[tuple[float, int]]]] = []
         extra = self._extra_labels
@@ -686,7 +734,8 @@ class RemoteWriteShipper:
             series.append((labels, [(value, ts_ms)]))
         proto = encode_write_request(series)
         self._seq += 1
-        payload = frame_batch(self._seq, wall, kind, len(series), proto)
+        payload = frame_batch(self._seq, wall, kind, len(series), proto,
+                              mono=mono)
         try:
             self.buffer.append(payload)
         except OSError as e:
@@ -697,6 +746,12 @@ class RemoteWriteShipper:
             self._seq -= 1
             with self._stats_lock:
                 self._stats["dropped"]["queue"] += 1
+            hook = self._pressure_hook
+            if hook is not None:
+                try:
+                    hook(e)  # ENOSPC sheds the disk ladder immediately
+                except Exception:  # noqa: BLE001 — governor must not break the writer
+                    pass
             self._rlog.warning("egress_append", "egress buffer append "
                                "failed: %s", e)
             return
@@ -707,7 +762,7 @@ class RemoteWriteShipper:
             if self._head_meta is None:
                 # First pending batch: seed the cached head metadata so the
                 # poll thread's backlog-age read never touches the disk.
-                self._head_meta = (self._seq, wall)
+                self._head_meta = (self._seq, wall, mono)
         self._work.set()
 
     def _enforce_caps(self) -> None:
@@ -718,16 +773,20 @@ class RemoteWriteShipper:
         consumer discipline makes that impossible. Each cap sheds in ONE
         cursor advance: trimming a long outage's backlog must not pay a
         cursor fsync per dropped batch."""
-        dropped = self.buffer.trim_to_bytes(self.max_backlog_bytes)
+        cap = self.max_backlog_bytes
+        if self._disk_pressure:
+            cap = max(cap // 8, SHED_SEGMENT_BYTES)
+        dropped = self.buffer.trim_to_bytes(cap)
         if self.max_backlog_age_s > 0:
             now = self._wallclock()
-            with self._stats_lock:
-                head_meta = self._head_meta
             # Cached head age first: the scan below re-reads batches from
             # disk, and paying that on EVERY sender iteration just to
             # learn the head is fresh would double the per-send head I/O.
-            if head_meta is None or (
-                now - head_meta[1] > self.max_backlog_age_s
+            # _head_age is the clock-step-fenced read: this-process
+            # batches age monotonically, so an NTP step can never trip
+            # the age cap into mass-dropping a healthy backlog.
+            if self._head_meta is None or (
+                self._head_age(now) > self.max_backlog_age_s
             ):
                 over_age = 0
                 while True:
@@ -736,7 +795,12 @@ class RemoteWriteShipper:
                         break
                     try:
                         head, _ = parse_batch(payload)
-                        if now - float(head["wall"]) <= self.max_backlog_age_s:
+                        # Per-batch age with the SAME clock-step fence as
+                        # the trigger: this-process batches age on their
+                        # enqueue-monotonic stamp, so a forward NTP step
+                        # sheds exactly the genuinely-over-age prefix —
+                        # never the healthy batches behind it.
+                        if self._batch_age(head, now) <= self.max_backlog_age_s:
                             break
                     except (ValueError, KeyError, TypeError):
                         pass  # unparseable: over-age by policy, shed with it
@@ -757,15 +821,17 @@ class RemoteWriteShipper:
             # restart right now cannot reuse the dropped batches' numbers.
             self._write_status()
 
-    def _peek_meta(self) -> tuple[int, float] | None:
-        """(seq, wall) of the oldest pending batch; refreshes the cached
-        head metadata. Sender-thread only (reads the buffer from disk)."""
+    def _peek_meta(self) -> tuple[int, float, float] | None:
+        """(seq, wall, seen_mono) of the oldest pending batch; refreshes
+        the cached head metadata. Sender-thread only (reads the buffer
+        from disk)."""
         payload = self.buffer.peek()
-        meta: tuple[int, float] | None = None
+        meta: tuple[int, float, float] | None = None
         if payload is not None:
             try:
                 head, _ = parse_batch(payload)
-                meta = (int(head["seq"]), float(head["wall"]))
+                meta = (int(head["seq"]), float(head["wall"]),
+                        float(head.get("mono", 0.0)))
             except (ValueError, KeyError, TypeError):
                 meta = None
         with self._stats_lock:
@@ -934,6 +1000,24 @@ class RemoteWriteShipper:
         except OSError:
             pass
 
+    # ------------------------------------------------- pressure-shed hooks
+
+    def set_disk_pressure(self, on: bool) -> None:
+        """Disk-ladder rung ``egress_compact`` (tpu_pod_exporter.pressure):
+        tiny segment rotation so acked bytes reclaim promptly (lossless)
+        plus a tightened pending-backlog cap (bounded loss only while the
+        receiver is down). Idempotent; reversed on recovery."""
+        self._disk_pressure = bool(on)
+        self.buffer.segment_max_bytes = (
+            SHED_SEGMENT_BYTES if on else self._normal_segment_bytes
+        )
+        self._work.set()  # wake the sender so the cap applies promptly
+
+    def set_pressure_hook(self, hook: Callable[[BaseException], bool]) -> None:
+        """Governor callback for buffer-append failures (ENOSPC sheds the
+        disk ladder immediately instead of waiting for a usage scan)."""
+        self._pressure_hook = hook
+
     # ----------------------------------------------------------------- state
 
     @property
@@ -944,17 +1028,40 @@ class RemoteWriteShipper:
             and self.breaker.reopens >= DEGRADED_AFTER_REOPENS
         )
 
+    def _batch_age(self, head: Mapping[str, Any], now_wall: float) -> float:
+        """Clock-step-fenced age of one batch header: batches created by
+        this process age on their enqueue-MONOTONIC stamp (exact — an NTP
+        step can neither inflate their age into an age-cap mass-drop nor
+        hide a genuinely stale slowly-draining backlog); batches restored
+        from a pre-restart backlog age on wall time (their mono stamp
+        belongs to a dead clock). Never negative either way (a
+        future-stamped batch reads as fresh, not as a fault)."""
+        mono = float(head.get("mono", 0.0))
+        # mono == 0: an unstamped frame (externally appended / older
+        # format) — wall age is the only honest read, never "monotonic
+        # since boot" (which would mass-expire it as ancient).
+        if mono > 0 and int(head.get("seq", 0)) > self._boot_seq:
+            return max(self._clock() - mono, 0.0)
+        return max(now_wall - float(head.get("wall", 0.0)), 0.0)
+
+    def _head_age(self, now_wall: float) -> float:
+        """:meth:`_batch_age` of the CACHED head metadata (poll-thread
+        safe: no buffer file reads)."""
+        with self._stats_lock:
+            meta = self._head_meta
+        if meta is None:
+            return 0.0
+        seq, wall, mono = meta
+        return self._batch_age({"seq": seq, "wall": wall, "mono": mono},
+                               now_wall)
+
     def backlog_age_s(self) -> float:
         """Age of the oldest pending batch, from the CACHED head metadata
         only — this is read on the poll thread (collector emit), which
         must never touch the buffer's files."""
         if self.buffer.pending() == 0:
             return 0.0
-        with self._stats_lock:
-            meta = self._head_meta
-        if meta is None:
-            return 0.0
-        return max(self._wallclock() - meta[1], 0.0)
+        return self._head_age(self._wallclock())
 
     def stats(self) -> dict:
         with self._stats_lock:
@@ -968,6 +1075,7 @@ class RemoteWriteShipper:
         out["breaker_reopens"] = self.breaker.reopens
         out["seq"] = self._seq
         out["degraded"] = self.degraded
+        out["disk_pressure"] = self._disk_pressure
         if self._open_errors:
             out["open_errors"] = list(self._open_errors)
         return out

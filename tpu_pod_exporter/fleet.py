@@ -103,6 +103,23 @@ class _QueryCache:
         self.entries = entries
         self._lock = threading.Lock()
         self._data: OrderedDict[tuple, dict] = OrderedDict()
+        # key -> estimated envelope bytes, maintained alongside _data so
+        # bytes() is O(1): the memory-pressure ladder reads it every check
+        # interval, and the shed decision must see the same number
+        # /debug/vars reports.
+        self._sizes: dict[tuple, int] = {}
+        self._bytes = 0
+        # Flipped by the memory-pressure ladder's fleet_cache rung: while
+        # disabled, put() is a no-op (every query re-fans-out — pure
+        # correctness, just slower dashboards).
+        self._enabled = True
+
+    @staticmethod
+    def _estimate(env: dict) -> int:
+        try:
+            return len(json.dumps(env, default=str))
+        except (TypeError, ValueError):
+            return 1024
 
     def get(self, key: tuple) -> dict | None:
         with self._lock:
@@ -114,11 +131,40 @@ class _QueryCache:
     def put(self, key: tuple, env: dict) -> None:
         if self.entries <= 0:
             return
+        size = self._estimate(env)
         with self._lock:
+            # _enabled re-checked INSIDE the lock: a put racing the
+            # memory-ladder's set_enabled(False)+clear() must not land
+            # after the clear and leave a "disabled" cache serving (and
+            # accounting) a stale entry.
+            if not self._enabled:
+                return
+            self._bytes += size - self._sizes.get(key, 0)
+            self._sizes[key] = size
             self._data[key] = env
             self._data.move_to_end(key)
             while len(self._data) > self.entries:
-                self._data.popitem(last=False)
+                victim, _ = self._data.popitem(last=False)
+                self._bytes -= self._sizes.pop(victim, 0)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._data.clear()
+            self._sizes.clear()
+            self._bytes = 0
+
+    def set_enabled(self, enabled: bool) -> None:
+        with self._lock:
+            # Flag + clear under ONE lock hold (see put's re-check).
+            self._enabled = bool(enabled)
+            if not enabled:
+                self._data.clear()
+                self._sizes.clear()
+                self._bytes = 0
+
+    def bytes(self) -> int:
+        with self._lock:
+            return self._bytes
 
     def __len__(self) -> int:
         with self._lock:
@@ -538,7 +584,22 @@ class FleetQueryPlane:
             "timeout_s": self._timeout_s,
             "cache_entries": len(self._cache),
             "cache_capacity": self._cache.entries,
+            # The SAME estimate the memory-pressure ladder's shed decision
+            # sums — /debug/vars and the governor must never disagree.
+            "cache_bytes": self._cache.bytes(),
         }
+
+    # ------------------------------------------------- pressure shed hook
+
+    def cache_bytes(self) -> int:
+        """Byte estimate of the result cache, for the memory budget's
+        component accounting (tpu_pod_exporter.pressure)."""
+        return self._cache.bytes()
+
+    def set_cache_enabled(self, enabled: bool) -> None:
+        """Memory-ladder rung ``fleet_cache``: clear + disable the result
+        cache (queries re-fan-out; correctness unchanged). Reversible."""
+        self._cache.set_enabled(enabled)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

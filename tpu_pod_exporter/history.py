@@ -565,6 +565,42 @@ class HistoryStore:
             self._evict_gen += 1
             self._layouts.clear()
 
+    # ------------------------------------------------- pressure shed hook
+
+    def set_capacity(self, new_cap: int) -> None:
+        """Rebuild every raw ring at ``new_cap``, keeping each series'
+        NEWEST samples — the memory-pressure ladder's ``history_cut`` rung
+        (tpu_pod_exporter.pressure). The downsample tiers are untouched
+        (coarse tiers shed LAST: they are the cheapest bytes per second of
+        answerable history), so long-window queries keep answering while
+        raw-resolution retention halves. Reversible: a larger ``new_cap``
+        re-grows the rings (existing samples preserved)."""
+        new_cap = max(int(new_cap), 2)
+        with self._lock:
+            if new_cap == self.capacity:
+                return
+            zeros = bytes(8 * new_cap)
+            for s in self._series.values():
+                keep = min(s.n, new_cap)
+                start = (s.head - keep) % s.cap
+                tm = array("d", zeros)
+                tw = array("d", zeros)
+                vals = array("d", zeros)
+                for k in range(keep):
+                    i = (start + k) % s.cap
+                    tm[k] = s.tm[i]
+                    tw[k] = s.tw[i]
+                    vals[k] = s.vals[i]
+                self._samples -= s.n - keep
+                s.tm, s.tw, s.vals = tm, tw, vals
+                s.cap = new_cap
+                s.n = keep
+                s.head = keep % new_cap
+            self.capacity = new_cap
+            # The cached layouts hold the same _Series objects (still
+            # valid — identity unchanged), so the steady-state append
+            # fast path keeps working across the rebuild.
+
     # ----------------------------------------------- persistence (persist.py)
 
     def export_series(self) -> list[tuple[str, dict, list[tuple[float, float]]]]:

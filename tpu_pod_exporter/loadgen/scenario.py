@@ -49,10 +49,13 @@ import urllib.request
 from tpu_pod_exporter import utils as _utils
 from tpu_pod_exporter.chaos import (
     ChaosReceiver,
+    ClockStepper,
     PartitionState,
     PartitionedFetch,
     PartitionedSend,
+    ScrapeStorm,
 )
+from tpu_pod_exporter.pressure import PressureGovernor, dir_usage_bytes
 from tpu_pod_exporter.loadgen.fleet import (
     _ShardSim,
     _compare_oracle,
@@ -81,9 +84,15 @@ def _get_json(url: str, timeout_s: float = 5.0) -> dict:
 class _Run:
     """One scenario against one freshly-built stack."""
 
+    # Admission caps on the root's serving tier while the governor is on
+    # (the scrape_storm drill's bound; generous for every other scenario).
+    STORM_CONN_CAP = 32
+    STORM_CLIENT_CAP = 8
+
     def __init__(self, scn: Scenario, n_targets: int, shards: int,
                  chips: int, state_root: str, seed: int,
-                 stale_serve_s: float = 30.0) -> None:
+                 stale_serve_s: float = 30.0,
+                 governor: bool = True) -> None:
         from tpu_pod_exporter.egress import (
             RemoteWriteShipper,
             aggregator_egress_metrics,
@@ -113,10 +122,15 @@ class _Run:
         )
         self.membership: list[str] = list(self.sim.farm.targets())
         # Root /readyz over real HTTP: partition-aware degradation is an
-        # operator contract, so it is asserted through the wire.
+        # operator contract, so it is asserted through the wire. With the
+        # governor on, the serving tier also carries the admission caps
+        # the scrape_storm drill storms against.
+        self.governor_on = governor
         self.root_server = MetricsServer(
             self.sim.root_store, host="127.0.0.1", port=0,
             ready_detail_fn=self.sim.root.ready_detail,
+            max_open_connections=self.STORM_CONN_CAP if governor else 0,
+            max_requests_per_client=self.STORM_CLIENT_CAP if governor else 0,
         )
         self.root_server.start()
         # Two-level query plane, partitioned at the root→leaf seam.
@@ -144,21 +158,68 @@ class _Run:
         # partitionable sender; the ledger is the zero-loss oracle.
         self.receiver = None
         self.shipper = None
+        self.egress_dir = os.path.join(state_root, "egress")
+        # Wall clock the clock_step events step: the shipper ages its
+        # backlog against it, so the fence (this-process batches age
+        # monotonically) is exercised through a real component.
+        self.clock = ClockStepper()
         if scn.uses_egress:
             self.receiver = ChaosReceiver([], seed=seed)
             self.receiver.start()
             self.shipper = RemoteWriteShipper(
                 self.receiver.url,
-                os.path.join(state_root, "egress"),
+                self.egress_dir,
                 metrics=aggregator_egress_metrics(),
                 interval_s=0.0,
                 timeout_s=2.0,
                 breaker=build_breaker(2, 0.3, 1.5),
                 extra_labels={"host": "scenario-root"},
                 send=PartitionedSend(self.net, "root", "recv", default_send),
+                wallclock=self.clock,
             )
             self.shipper.load()
             self.shipper.start()
+        # Resource-pressure governor over the root-side stack: the disk
+        # ladder watches the egress dir (segment compaction rung), the
+        # memory ladder the byte-accounted caches (leaf fleet caches
+        # first, root stale-serve views second — coarse data last).
+        # Budgets start at 0 (no pressure); the disk_full / mem_pressure
+        # events squeeze them mid-run. Ticked synchronously per round —
+        # deterministic, no governor thread in the engine.
+        self.gov: PressureGovernor | None = None
+        if governor:
+            self.gov = PressureGovernor(
+                check_interval_s=0.05, hysteresis_s=0.3)
+            if self.shipper is not None:
+                self.gov.add_disk_path(self.egress_dir)
+                self.gov.add_disk_rung(
+                    "egress_compact",
+                    lambda: self.shipper.set_disk_pressure(True),
+                    lambda: self.shipper.set_disk_pressure(False),
+                )
+            self.gov.register_memory_component(
+                "fleet_caches", self._leaf_cache_bytes)
+            self.gov.register_memory_component(
+                "stale_views", self.sim.root.stale_view_bytes)
+            self.gov.add_memory_rung(
+                "fleet_cache",
+                lambda: self._set_leaf_caches(False),
+                lambda: self._set_leaf_caches(True),
+            )
+            self.gov.add_memory_rung(
+                "stale_views",
+                lambda: self.sim.root.shed_stale_views(),
+                lambda: None,
+            )
+        # Pressure-drill state.
+        self.disk_usage_at_squeeze = 0
+        self.disk_budget_target = 0
+        self.disk_batch_est = 4096
+        self.mem_budget_target = 0
+        self.storm: ScrapeStorm | None = None
+        self.storm_baseline_p99: float | None = None
+        self.storm_p99s: list[float] = []
+        self._polite_conn = None  # lazy http.client keep-alive connection
         self.baseline_series: set | None = None
         self.baseline_workloads = 0
         self.rss_baseline: float | None = None
@@ -172,6 +233,49 @@ class _Run:
         self.restart_batches: dict[int, tuple[int, ...]] = {}
         self.trace: list[dict] = []
         self.problems: list[str] = []
+
+    # ------------------------------------------------------- pressure helpers
+
+    def _leaf_cache_bytes(self) -> int:
+        """Summed leaf fleet-cache byte estimates — the memory ladder's
+        first component (live dict walk: leaves restart/replace)."""
+        total = 0
+        for leaf in self.sim.leaves.values():
+            if leaf.fleet is not None:
+                total += leaf.fleet.cache_bytes()
+        return total
+
+    def _set_leaf_caches(self, enabled: bool) -> None:
+        for leaf in self.sim.leaves.values():
+            if leaf.fleet is not None:
+                leaf.fleet.set_cache_enabled(enabled)
+
+    def _accounted_memory(self) -> int:
+        """The memory invariant's number, computed directly so the
+        governor-off negative control measures the same thing."""
+        return self._leaf_cache_bytes() + self.sim.root.stale_view_bytes()
+
+    def _polite_p99(self, n: int) -> float:
+        """Latency of a polite scraper against the root's /metrics: ONE
+        long-lived keep-alive connection (established before any storm —
+        the incumbent-scraper shape admission control protects; its
+        source is 127.0.0.1, distinct from the storm's 127.0.0.N pool)."""
+        import http.client
+
+        if self._polite_conn is None:
+            self._polite_conn = http.client.HTTPConnection(
+                "127.0.0.1", self.root_server.port, timeout=10)
+        lat: list[float] = []
+        for _ in range(n):
+            t0 = time.perf_counter()
+            self._polite_conn.request("GET", "/metrics")
+            resp = self._polite_conn.getresponse()
+            resp.read()
+            if resp.status != 200:
+                raise RuntimeError(f"polite scrape got {resp.status}")
+            lat.append(time.perf_counter() - t0)
+        lat.sort()
+        return lat[min(int(n * 0.99), n - 1)]
 
     # ------------------------------------------------------------ event hooks
 
@@ -231,6 +335,43 @@ class _Run:
             }
         elif ev.kind == "recv_outage" and self.receiver is not None:
             self.receiver.set_outage(True)
+        elif ev.kind == "disk_full":
+            # Squeeze the disk budget to half the CURRENT usage: a breach
+            # is guaranteed whatever the absolute batch sizes are, and the
+            # per-batch estimate anchors the post-shed floor (steady state
+            # after compaction is O(one segment + one batch), never an
+            # arbitrary fraction of an arbitrary budget).
+            usage = dir_usage_bytes(self.egress_dir)
+            enq = 1
+            if self.shipper is not None:
+                enq = max(self.shipper.stats()["enqueued_batches"], 1)
+            self.disk_usage_at_squeeze = usage
+            self.disk_batch_est = max(usage // enq, 2048)
+            self.disk_budget_target = max(usage // 2, 1024)
+            if self.gov is not None:
+                self.gov.set_disk_budget_bytes(self.disk_budget_target)
+        elif ev.kind == "mem_pressure":
+            # Budget = current accounted + one small delta: the query
+            # traffic the window drives adds far more than the delta, so
+            # governor-off breaches deterministically while governor-on
+            # (caches cleared + disabled) stays under.
+            self.mem_budget_target = self._accounted_memory() + 2048
+            if self.gov is not None:
+                self.gov.set_memory_budget_bytes(self.mem_budget_target)
+        elif ev.kind == "scrape_storm":
+            try:
+                self.storm_baseline_p99 = self._polite_p99(12)
+            except (OSError, RuntimeError) as e:
+                self.problems.append(
+                    f"polite scraper failed BEFORE the storm: {e}")
+                self.storm_baseline_p99 = None
+            self.storm_p99s = []
+            self.storm = ScrapeStorm(
+                "127.0.0.1", self.root_server.port, conns=ev.count,
+                pause_s=0.02)
+            self.storm.start()
+        elif ev.kind == "clock_step":
+            self.clock.step(ev.step_s)
 
     def _end_event(self, ev: ScenarioEvent) -> None:
         farm = self.sim.farm
@@ -256,6 +397,17 @@ class _Run:
             farm.hot = set()
         elif ev.kind == "recv_outage" and self.receiver is not None:
             self.receiver.set_outage(False)
+        elif ev.kind == "disk_full":
+            # The operator freed space / raised the budget: pressure off,
+            # and the settle loop must see the ladder recover to 0.
+            if self.gov is not None:
+                self.gov.set_disk_budget_bytes(0)
+        elif ev.kind == "mem_pressure":
+            if self.gov is not None:
+                self.gov.set_memory_budget_bytes(0)
+        elif ev.kind == "scrape_storm":
+            if self.storm is not None:
+                self.storm.stop()
 
     def _tick_event(self, ev: ScenarioEvent, r: int) -> None:
         """Per-round continuation for windowed events."""
@@ -272,6 +424,37 @@ class _Run:
             self.membership = self.membership[k:] + added
             farm.pod_gen += 1  # the label-churn half of the storm
             self.sim.write_targets(self.membership)
+        elif ev.kind == "disk_full" and self.shipper is not None:
+            # Keep FRESH batches landing through the window (a full extra
+            # round, never a re-push of the same snapshot — identical
+            # sample timestamps would corrupt the exactly-once ledger this
+            # very drill asserts): the negative control's usage growth
+            # must be monotone.
+            self.sim.run_round()
+            self.shipper.on_snapshot(self.sim.root_store.current())
+            time.sleep(0.05)  # let the writer thread land the append
+        elif ev.kind == "mem_pressure":
+            # Drive dashboard-shaped query traffic so the leaf fleet
+            # caches actually grow: generation bumps per round make every
+            # window a fresh cache key.
+            for k in range(3):
+                try:
+                    self.plane.window_stats(
+                        "tpu_hbm_used_bytes",
+                        window_s=float(30 + 10 * r + k),
+                    )
+                except Exception:  # noqa: BLE001 — traffic, not an assertion
+                    pass
+        elif ev.kind == "scrape_storm" and self.storm is not None:
+            try:
+                self.storm_p99s.append(self._polite_p99(8))
+            except (OSError, RuntimeError) as e:
+                # The incumbent polite scraper being rejected/disconnected
+                # mid-storm IS an invariant failure — recorded, never a
+                # crash that aborts the whole suite.
+                self._polite_conn = None  # reconnect on the next probe
+                self.problems.append(
+                    f"r{r}: polite scraper failed during the storm: {e}")
 
     # -------------------------------------------------------------- the drive
 
@@ -292,6 +475,13 @@ class _Run:
                 self.sim.run_round()
                 if self.shipper is not None:
                     self.shipper.on_snapshot(self.sim.root_store.current())
+                if self.gov is not None:
+                    # Two synchronous ticks: at most one rung moves per
+                    # tick, and the deeper ladders need to climb within a
+                    # window measured in rounds.
+                    time.sleep(0.06)  # past check_interval + writer drain
+                    self.gov.tick()
+                    self.gov.tick()
                 self._check_tick(r)
                 if self.problems:
                     result["failed_round"] = r
@@ -515,10 +705,78 @@ class _Run:
                     f"egress exposition (breaker never opened / no "
                     f"backlog)")
 
+        # --- resource-pressure drills: window-end invariants --------------
+        for ev in active:
+            if ev.end_round - 1 != r:
+                continue
+            if ev.kind == "disk_full":
+                usage = dir_usage_bytes(self.egress_dir)
+                # Post-shed floor: compaction's steady state is one shed
+                # segment plus ~a batch in flight — an absolute budget
+                # below one batch is unmeetable BY ANY policy, so the
+                # invariant is bounded by physics, not wishes.
+                floor = 2 * self.disk_batch_est + (12 << 10)
+                if usage > max(self.disk_budget_target, floor):
+                    problems.append(
+                        f"r{r}: disk usage {usage}B still over the "
+                        f"squeezed budget {self.disk_budget_target}B "
+                        f"(floor {floor}B) at window end — nothing shed")
+                if self.gov is not None:
+                    gs = self.gov.stats()["disk"]
+                    # A ladder that shed and already recovered (usage
+                    # reclaimed, hysteresis elapsed) is the governor
+                    # WORKING — the invariant is that shedding happened
+                    # and was counted, not that a rung is still held.
+                    if gs["sheds"] < 1:
+                        problems.append(
+                            f"r{r}: disk_full window ended with zero "
+                            f"recorded sheds (ladder inert)")
+            elif ev.kind == "mem_pressure":
+                accounted = self._accounted_memory()
+                if accounted > self.mem_budget_target:
+                    problems.append(
+                        f"r{r}: accounted memory {accounted}B over the "
+                        f"squeezed budget {self.mem_budget_target}B at "
+                        f"window end — nothing shed")
+                if self.gov is not None:
+                    if self.gov.stats()["memory"]["sheds"] < 1:
+                        problems.append(
+                            f"r{r}: mem_pressure window ended with zero "
+                            f"recorded memory sheds (ladder inert)")
+            elif ev.kind == "scrape_storm" and self.storm is not None:
+                st = self.storm.stats()
+                peak = self.root_server.conn_stats["peak"]
+                if self.governor_on:
+                    if st["rejected"] == 0:
+                        problems.append(
+                            f"r{r}: a {self.storm.conns}-conn storm drew "
+                            f"zero 429s (admission control inert)")
+                    if peak > self.STORM_CONN_CAP:
+                        problems.append(
+                            f"r{r}: open connections peaked at {peak} "
+                            f"over the {self.STORM_CONN_CAP} cap")
+                base = self.storm_baseline_p99
+                if self.storm_p99s and base:
+                    worst = max(self.storm_p99s)
+                    # Engine budget is generous (shared CI runners); the
+                    # strict 5% contract lives in make pressure-demo.
+                    if worst > max(3.0 * base, base + 0.25):
+                        problems.append(
+                            f"r{r}: polite scrape p99 {1e3 * worst:.1f}ms "
+                            f"during the storm vs {1e3 * base:.1f}ms "
+                            f"baseline — serving latency not protected")
+
         self.problems.extend(problems)
         self.trace.append({
             "round": r,
             "active": [ev.raw for ev in active],
+            "pressure": (
+                {
+                    "disk": self.gov.stats()["disk"]["level"],
+                    "memory": self.gov.stats()["memory"]["level"],
+                }
+                if self.gov is not None else None
+            ),
             "cuts": [list(c) for c in self.net.active()],
             "leaf_down": sorted(
                 leaf for (_s, leaf), v in leaf_up.items() if v == 0.0),
@@ -574,6 +832,14 @@ class _Run:
             self.sim.run_round()
             if self.shipper is not None:
                 self.shipper.on_snapshot(self.sim.root_store.current())
+            if self.gov is not None:
+                self.gov.tick()
+                gs = self.gov.stats()
+                if gs["disk"]["level"] or gs["memory"]["level"]:
+                    # Ladders must step back to 0 (hysteresis) before the
+                    # stack can count as recovered.
+                    time.sleep(0.15)
+                    continue
             body = self.sim.root_body()
             fams = parse_families(body)
             target_up = {
@@ -598,11 +864,19 @@ class _Run:
                     break
             time.sleep(0.15)
         result["recovered"] = recovered
+        if self.gov is not None:
+            gs = self.gov.stats()
+            result["pressure"] = {
+                "disk": {k: gs["disk"][k]
+                         for k in ("level", "sheds", "recovers")},
+                "memory": {k: gs["memory"][k]
+                           for k in ("level", "sheds", "recovers")},
+            }
         if not recovered:
             self.problems.append(
                 "stack did not converge back to healthy + oracle-equal "
                 "within the settle budget (quarantine black-hole after "
-                "heal?)")
+                "heal, or a pressure ladder stuck above level 0?)")
             return False
 
         # /readyz healthy again, over the wire.
@@ -691,6 +965,10 @@ class _Run:
         return False
 
     def _close(self) -> None:
+        if self.storm is not None:
+            self.storm.stop()
+        if self._polite_conn is not None:
+            self._polite_conn.close()
         try:
             self.root_server.stop()
         except Exception:  # noqa: BLE001 — teardown must finish
@@ -704,21 +982,25 @@ class _Run:
 
 
 def run_scenarios(names: list[str], n_targets: int, shards: int,
-                  chips: int, state_root: str, seed: int) -> dict:
+                  chips: int, state_root: str, seed: int,
+                  governor: bool = True) -> dict:
     """Run the named scenarios back to back, each on a fresh stack (own
     state dir under ``state_root``); returns the summary dict the demo
-    prints and writes as the CI artifact."""
+    prints and writes as the CI artifact. ``governor=False`` is the
+    pressure drills' negative control: the invariants still run, and the
+    run is EXPECTED to fail them."""
     os.makedirs(state_root, exist_ok=True)
     summary: dict = {
         "ok": True, "targets": n_targets, "shards": shards,
-        "seed": seed, "scenarios": {},
+        "seed": seed, "governor": governor, "scenarios": {},
     }
     all_traces: dict[str, list] = {}
     for name in names:
         scn = SCENARIOS[name]
         t0 = time.monotonic()
         run = _Run(scn, n_targets, shards, chips,
-                   os.path.join(state_root, name), seed)
+                   os.path.join(state_root, name), seed,
+                   governor=governor)
         result = run.run()
         result["wall_s"] = round(time.monotonic() - t0, 2)
         all_traces[name] = run.trace
@@ -766,6 +1048,11 @@ def main(argv: list[str] | None = None) -> int:
                    help="per-scenario state dirs + result.json + "
                         "scenario-trace.json (uploaded as a CI artifact "
                         "on failure)")
+    p.add_argument("--governor", default="on", choices=("on", "off"),
+                   help="off = the pressure drills' NEGATIVE CONTROL: no "
+                        "governor, no admission caps — the invariants "
+                        "still run and the drill is expected to FAIL "
+                        "(CI asserts the non-zero exit)")
     p.add_argument("--log-level", default="warning")
     ns = p.parse_args(argv)
     _utils.setup_logging(ns.log_level)
@@ -784,9 +1071,12 @@ def main(argv: list[str] | None = None) -> int:
             p.error(f"unknown scenario(s) {unknown}; "
                     f"known: {', '.join(SCENARIOS)}")
     print(f"scenario engine: {len(names)} scenario(s), {ns.targets} "
-          f"targets / {ns.shards} HA shards, seed {ns.seed}")
+          f"targets / {ns.shards} HA shards, seed {ns.seed}"
+          + (" — GOVERNOR OFF (negative control)"
+             if ns.governor == "off" else ""))
     summary = run_scenarios(names, ns.targets, ns.shards, ns.chips,
-                            ns.state_root, ns.seed)
+                            ns.state_root, ns.seed,
+                            governor=ns.governor == "on")
     if not summary["ok"]:
         failed = [n for n, r in summary["scenarios"].items()
                   if not r["ok"]]

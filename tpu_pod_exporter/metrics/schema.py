@@ -477,14 +477,16 @@ TPU_EXPORTER_PERSIST_SNAPSHOTS_TOTAL = MetricSpec(
 
 TPU_EXPORTER_PERSIST_ERRORS_TOTAL = MetricSpec(
     name="tpu_exporter_persist_errors_total",
-    help="Persistence I/O failures since start (WAL writes, fsyncs, checkpoint rotations). Rising = the state dir's filesystem is failing; the exporter keeps polling but the next restart will cold-start or restore stale state.",
+    help="Persistence I/O failures since start (WAL writes, fsyncs, checkpoint rotations), by reason: 'disk_full' (ENOSPC/EDQUOT — the disk is FULL, not flaky; the resource-pressure governor sheds on it) vs 'io' (every other filesystem fault). Rising = the state dir's filesystem is failing; the exporter keeps polling but the next restart will cold-start or restore stale state.",
     type=COUNTER,
+    label_names=("reason",),
 )
 
 TPU_EXPORTER_PERSIST_DROPPED_TOTAL = MetricSpec(
     name="tpu_exporter_persist_dropped_total",
-    help="Poll records dropped because the persistence writer's queue was full (stalled disk): polling is never blocked by persistence, so sustained drops mean history restored after a crash will have holes.",
+    help="Poll records dropped by persistence WITHOUT being written, by reason: 'queue' (writer queue full — stalled disk), 'disk_full' (the write itself hit ENOSPC/EDQUOT), 'io' (other write failure), 'shed' (deliberately thinned/skipped by the resource-pressure governor's WAL rungs). Polling is never blocked by persistence, so sustained drops mean history restored after a crash will have holes.",
     type=COUNTER,
+    label_names=("reason",),
 )
 
 TPU_EXPORTER_PERSIST_FSYNC_SECONDS = MetricSpec(
@@ -507,6 +509,50 @@ PERSIST_SPECS: tuple[MetricSpec, ...] = (
     TPU_EXPORTER_PERSIST_DROPPED_TOTAL,
     TPU_EXPORTER_PERSIST_FSYNC_SECONDS,
     TPU_EXPORTER_PERSIST_SNAPSHOT_AGE_SECONDS,
+)
+
+# --- Resource-pressure governor (tpu_pod_exporter.pressure) ------------------
+# Emitted only when a governor is attached (a disk or memory budget is
+# configured) — the same conditional-surface rule as PERSIST_SPECS. The
+# whole point of the governor is that degradation under ENOSPC/RSS
+# pressure happens BY POLICY and is attributable from the exposition
+# alone: the ladder rung is a gauge, every shed/recover a counted
+# transition, and the bytes-vs-budget pair the decision was made on is
+# published verbatim.
+
+TPU_EXPORTER_PRESSURE_STATE = MetricSpec(
+    name="tpu_exporter_pressure_state",
+    help="Resource-pressure degradation ladder rung per resource ('disk', 'memory'): 0 = no shedding; each higher rung is one more deliberate degradation (disk: WAL thinning -> egress compaction/trim -> checkpoint halving -> WAL off; memory: fleet-cache off -> trace-ring halving -> raw history-ring cut). Recovery steps down rung by rung with hysteresis.",
+    type=GAUGE,
+    label_names=("resource",),
+)
+
+TPU_EXPORTER_PRESSURE_BYTES = MetricSpec(
+    name="tpu_exporter_pressure_bytes",
+    help="Accounted usage per governed resource: 'disk' = bytes on disk under --state-dir plus --egress-dir; 'memory' = byte-accounted total of the registered in-memory components (history rings, trace ring, fleet query cache, root stale-serve views).",
+    type=GAUGE,
+    label_names=("resource",),
+)
+
+TPU_EXPORTER_PRESSURE_BUDGET_BYTES = MetricSpec(
+    name="tpu_exporter_pressure_budget_bytes",
+    help="Configured budget per governed resource (--state-max-disk-mb / --memory-budget-mb); 0 = no byte budget (the disk ladder still sheds on reported ENOSPC).",
+    type=GAUGE,
+    label_names=("resource",),
+)
+
+TPU_EXPORTER_PRESSURE_TRANSITIONS_TOTAL = MetricSpec(
+    name="tpu_exporter_pressure_transitions_total",
+    help="Ladder transitions per resource and direction ('shed' = one rung up under pressure, 'recover' = one rung released after the hysteresis window). A sawtooth here means the budget sits exactly at the steady-state working set — raise it.",
+    type=COUNTER,
+    label_names=("resource", "direction"),
+)
+
+PRESSURE_SPECS: tuple[MetricSpec, ...] = (
+    TPU_EXPORTER_PRESSURE_STATE,
+    TPU_EXPORTER_PRESSURE_BYTES,
+    TPU_EXPORTER_PRESSURE_BUDGET_BYTES,
+    TPU_EXPORTER_PRESSURE_TRANSITIONS_TOTAL,
 )
 
 # --- Remote-write egress (tpu_pod_exporter.egress) ---------------------------

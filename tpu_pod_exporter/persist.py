@@ -372,6 +372,20 @@ class StatePersister:
         self._last_rotate = 0.0
         self._fam_keys: dict[str, tuple] = {}
         self._fam_names: tuple[str, ...] = ()
+        # Resource-pressure shed state (tpu_pod_exporter.pressure): the
+        # governor's disk-ladder rungs flip these. Written on the governor
+        # thread, read on the writer thread — plain attribute flips, no
+        # lock needed (any single read is consistent).
+        self._wal_stride = 1       # write every Nth samples record
+        self._wal_enabled = True   # False = WAL-off-but-serving (last rung)
+        self._snapshot_factor = 1.0  # checkpoint interval multiplier
+        self._stride_seq = 0
+        # Checkpoint retry: a failed rotation retries on this cadence
+        # instead of waiting out a full snapshot interval (the WAL-reopen
+        # discipline applied to the checkpoint path).
+        self._snapshot_failed = False
+        self._last_snapshot_attempt = 0.0
+        self._pressure_hook: Callable[[BaseException], bool] | None = None
         self._stats_lock = threading.Lock()
         self._stats = {
             "wal_records": 0,
@@ -383,6 +397,12 @@ class StatePersister:
             "fsyncs": 0,
             "last_fsync_s": 0.0,
             "last_snapshot_wall": 0.0,
+        }
+        # Reason splits (same totals as errors/dropped above): ENOSPC is a
+        # FULL disk, not a flaky one — the DiskPressure alert keys on it.
+        self._errors_by_reason = {"disk_full": 0, "io": 0}
+        self._dropped_by_reason = {
+            "queue": 0, "disk_full": 0, "io": 0, "shed": 0,
         }
         self.restored_info: dict = {"restored": False}
         self._dir_error: str | None = None
@@ -404,6 +424,13 @@ class StatePersister:
         if self._dir_error is not None:
             rs.errors.append(self._dir_error)
             return rs
+        # Orphaned temp files from atomic writes a crash (or ENOSPC)
+        # interrupted between write and rename: reclaim them before they
+        # silently eat the very disk budget the pressure governor polices.
+        # Age 0 is safe here — load() runs before the writer thread exists.
+        from tpu_pod_exporter.pressure import reclaim_tmp_files
+
+        reclaim_tmp_files([self.state_dir], min_age_s=0.0)
         try:
             self._load_inner(rs)
         except Exception as e:  # noqa: BLE001 — NEVER refuse to start
@@ -614,8 +641,7 @@ class StatePersister:
             self._q.put_nowait(item)
             return True
         except queue.Full:
-            with self._stats_lock:
-                self._stats["dropped"] += 1
+            self._count_dropped("queue")
             self._rlog.warning(
                 "persist_drop",
                 "persistence queue full (writer stalled?); dropping a WAL "
@@ -626,9 +652,59 @@ class StatePersister:
     def stats(self) -> dict:
         with self._stats_lock:
             out = dict(self._stats)
+            out["errors_by_reason"] = dict(self._errors_by_reason)
+            out["dropped_by_reason"] = dict(self._dropped_by_reason)
         out["queue_depth"] = self._q.qsize()
         out["restored"] = self.restored_info.get("restored", False)
+        out["wal_stride"] = self._wal_stride
+        out["wal_enabled"] = self._wal_enabled
+        out["snapshot_factor"] = self._snapshot_factor
         return out
+
+    # ------------------------------------------------- pressure-shed hooks
+    # Flipped by the resource-pressure governor's disk ladder
+    # (tpu_pod_exporter.pressure). Plain attribute writes read by the
+    # writer thread; each rung is idempotent and individually reversible.
+
+    def set_wal_stride(self, n: int) -> None:
+        """Rung 1 (``wal_coarse``): write only every ``n``-th per-poll
+        samples record. Skipped polls are counted as reason="shed" drops —
+        a thinner WAL is a POLICY, and the restore-fidelity cost must stay
+        visible. Layout/breaker records always write (tiny, and replay
+        correctness needs them)."""
+        self._wal_stride = max(int(n), 1)
+
+    def set_wal_enabled(self, enabled: bool) -> None:
+        """Rung 4 (``wal_off``): the deepest shed — no WAL records at all,
+        checkpoints (at whatever cadence rung 3 left) remain the only
+        durability. The exporter keeps serving throughout."""
+        self._wal_enabled = bool(enabled)
+
+    def set_snapshot_interval_factor(self, factor: float) -> None:
+        """Rung 3 (``checkpoint_halved``): multiply the checkpoint
+        interval (2.0 halves the frequency — worst-case restore staleness
+        doubles, disk writes halve)."""
+        self._snapshot_factor = max(float(factor), 1.0)
+
+    def set_pressure_hook(self, hook: Callable[[BaseException], bool]) -> None:
+        """Governor callback for write failures: ENOSPC reports shed the
+        disk ladder immediately instead of waiting for a usage scan."""
+        self._pressure_hook = hook
+
+    def _count_dropped(self, reason: str) -> None:
+        with self._stats_lock:
+            self._stats["dropped"] += 1
+            self._dropped_by_reason[reason] = (
+                self._dropped_by_reason.get(reason, 0) + 1
+            )
+
+    @staticmethod
+    def _io_reason(exc: BaseException | None) -> str:
+        from tpu_pod_exporter.pressure import is_disk_full_error
+
+        return "disk_full" if (
+            exc is not None and is_disk_full_error(exc)
+        ) else "io"
 
     def close(self, timeout: float = 10.0) -> None:
         """Drain the queue, write a final fsynced snapshot (the SIGTERM
@@ -651,7 +727,7 @@ class StatePersister:
         try:
             self._open_wal()
         except OSError as e:
-            self._count_error("WAL open failed: %s", e)
+            self._count_error("WAL open failed: %s", e, exc=e)
         while True:
             try:
                 item = self._q.get(timeout=0.25)
@@ -666,7 +742,7 @@ class StatePersister:
                 self._maybe_fsync()
                 self._maybe_rotate()
             except Exception as e:  # noqa: BLE001 — the writer must survive I/O faults
-                self._count_error("persistence write failed: %s", e)
+                self._count_error("persistence write failed: %s", e, exc=e)
 
     def _drain_and_stop(self, done: threading.Event) -> None:
         try:
@@ -692,9 +768,20 @@ class StatePersister:
             self._wal = None
         done.set()
 
-    def _count_error(self, fmt: str, *args: object) -> None:
+    def _count_error(self, fmt: str, *args: object,
+                     exc: BaseException | None = None) -> None:
+        reason = self._io_reason(exc)
         with self._stats_lock:
             self._stats["errors"] += 1
+            self._errors_by_reason[reason] = (
+                self._errors_by_reason.get(reason, 0) + 1
+            )
+        hook = self._pressure_hook
+        if hook is not None and exc is not None:
+            try:
+                hook(exc)
+            except Exception:  # noqa: BLE001 — the governor must not break the writer
+                pass
         self._rlog.warning("persist_error", fmt, *args)
 
     def _open_wal(self, truncate: bool = False) -> None:
@@ -737,9 +824,8 @@ class StatePersister:
             self._open_wal()
             return True
         except OSError as e:
-            self._count_error("WAL reopen failed: %s", e)
-            with self._stats_lock:
-                self._stats["dropped"] += 1
+            self._count_error("WAL reopen failed: %s", e, exc=e)
+            self._count_dropped(self._io_reason(e))
             return False
 
     def _write_breaker(self, name: str) -> None:
@@ -755,6 +841,16 @@ class StatePersister:
             self._stats["wal_bytes"] += n
 
     def _write_samples(self, snap: "Snapshot") -> None:
+        # Pressure shedding (disk ladder): WAL-off drops everything, the
+        # stride rung thins coverage to every Nth poll. Both are counted
+        # as reason="shed" drops — deliberate, but never silent.
+        self._stride_seq += 1
+        if not self._wal_enabled:
+            self._count_dropped("shed")
+            return
+        if self._wal_stride > 1 and self._stride_seq % self._wal_stride != 0:
+            self._count_dropped("shed")
+            return
         if not self._ensure_wal():
             return
         # Extract the tracked families from the (immutable) snapshot.
@@ -814,15 +910,42 @@ class StatePersister:
             self._stats["fsyncs"] += 1
             self._stats["last_fsync_s"] = self._clock() - t0
 
+    # A failed checkpoint retries on this cadence instead of waiting out a
+    # full --state-snapshot-interval-s (the WAL-reopen discipline applied
+    # to the checkpoint path: recover as soon as the filesystem does).
+    SNAPSHOT_RETRY_S = 5.0
+
     def _maybe_rotate(self) -> None:
         now = self._clock()
-        if (
-            self.snapshot_interval_s <= 0
-            or now - self._last_rotate < self.snapshot_interval_s
-        ):
+        if self.snapshot_interval_s <= 0:
             return
+        interval = self.snapshot_interval_s * self._snapshot_factor
+        if self._snapshot_failed:
+            # Failed-checkpoint retry cadence: every SNAPSHOT_RETRY_S, not
+            # every writer iteration (a full disk must not be hammered
+            # with checkpoint-sized writes 4x a second) and not the full
+            # interval (recover as soon as the filesystem does).
+            if now - self._last_snapshot_attempt < self.SNAPSHOT_RETRY_S:
+                return
+        elif now - self._last_rotate < interval:
+            return
+        self._last_snapshot_attempt = now
+        try:
+            self._write_snapshot()
+        except Exception as e:  # noqa: BLE001 — a failed checkpoint must retry, not wait
+            self._snapshot_failed = True
+            # atomic_write may have left a partial .tmp behind (ENOSPC
+            # mid-write): reclaim it now — a full disk is exactly when a
+            # dead temp file hurts most.
+            try:
+                os.unlink(self.snapshot_path + ".tmp")
+            except OSError:
+                pass
+            self._count_error("checkpoint rotation failed: %s (retrying "
+                              "in %.0fs)", e, self.SNAPSHOT_RETRY_S, exc=e)
+            return
+        self._snapshot_failed = False
         self._last_rotate = now
-        self._write_snapshot()
 
     def _write_snapshot(self) -> None:
         """Full checkpoint: history rings + breaker states + exposition,

@@ -22,6 +22,15 @@ Grammar (``--timeline``; events separated by ``;`` or top-level ``,``)::
                                       plus a workload label-churn wave
     hotspot(podname)                  one workload's duty/HBM spikes
     recv_outage()                     the remote-write receiver answers 503
+    disk_full()                       the disk budget collapses under the
+                                      durable-state dirs (pressure governor
+                                      must shed, reclaim, and recover)
+    mem_pressure()                    the memory budget collapses under the
+                                      byte-accounted caches/rings
+    scrape_storm(N)                   N aggressive keep-alive connections
+                                      hammer the serving tier
+    clock_step(S)                     one NTP-shaped wall-clock step of S
+                                      seconds (signed; instantaneous)
 
 ``@round`` is the event's first engine round (0-based); ``+duration`` is
 the window length in rounds (default 1). Examples::
@@ -58,6 +67,10 @@ from dataclasses import dataclass, field
 EVENT_KINDS: tuple[str, ...] = (
     "partition", "preempt", "restart_wave", "churn_storm", "hotspot",
     "recv_outage",
+    # Resource-pressure kinds (ISSUE 10): the MACHINE misbehaving —
+    # interpreted by the engine through the pressure governor and the
+    # chaos host-level injectors (ClockStepper / ScrapeStorm).
+    "disk_full", "mem_pressure", "scrape_storm", "clock_step",
 )
 
 TIERS: tuple[str, ...] = ("node", "leaf", "root", "recv")
@@ -92,8 +105,9 @@ class ScenarioEvent:
     edge: tuple[str, str] | None = None  # partition: (tierA, tierB) as given
     mode: str = ""                       # partition: symmetric|asymmetric|flapping
     subject: str = ""                    # preempt: slice id; hotspot: pod
-    count: int = 0                       # restart_wave / churn_storm
+    count: int = 0                       # restart_wave / churn_storm / scrape_storm
     stagger: int = 1                     # restart_wave: hosts per round
+    step_s: float = 0.0                  # clock_step: signed seconds
     raw: str = field(default="", compare=False)
 
     @property
@@ -244,9 +258,40 @@ def parse_event(raw: str) -> ScenarioEvent:
         ev.subject = args[0]
         return ev
 
-    # recv_outage
+    if kind == "scrape_storm":
+        if len(args) != 1:
+            raise _err(raw, "scrape_storm wants exactly (N connections)")
+        try:
+            ev.count = int(args[0])
+        except ValueError:
+            raise _err(raw, f"bad connection count {args[0]!r}: want an "
+                            f"integer") from None
+        if ev.count < 1:
+            raise _err(raw, f"connection count {ev.count} must be >= 1")
+        return ev
+
+    if kind == "clock_step":
+        if len(args) != 1:
+            raise _err(raw, "clock_step wants exactly (±seconds)")
+        try:
+            ev.step_s = float(args[0])
+        except ValueError:
+            raise _err(raw, f"bad step {args[0]!r}: want signed seconds, "
+                            f"e.g. -45 or +3600") from None
+        if ev.step_s == 0:
+            raise _err(raw, "a clock step of 0 seconds injects nothing")
+        # A step is an INSTANT, not a window: an explicit +duration would
+        # either re-step every round (compounding, lying about the fault)
+        # or idle (padding the injected window) — same rule as
+        # restart_wave's derived duration.
+        if m.group("dur") is not None:
+            raise _err(raw, "clock_step is instantaneous; drop the "
+                            "+duration")
+        return ev
+
+    # recv_outage / disk_full / mem_pressure
     if args:
-        raise _err(raw, f"recv_outage takes no arguments (got {args})")
+        raise _err(raw, f"{kind} takes no arguments (got {args})")
     return ev
 
 
@@ -365,6 +410,46 @@ SCENARIOS: dict[str, Scenario] = {
                 "returns to exactly the expected series set after settle."
             ),
             settle_rounds=4,
+        ),
+        Scenario(
+            name="disk_full",
+            timeline="clock_step(-45)@2; disk_full()@3+4",
+            description=(
+                "The disk budget under the durable-state dirs collapses "
+                "(with a backward NTP step landing first): the pressure "
+                "governor must shed by policy — egress segment "
+                "compaction reclaims acked bytes — bring usage back "
+                "down, keep the egress exactly-once ledger intact "
+                "through the whole window, and recover rung by rung "
+                "after the budget returns. The backward step must not "
+                "stall batch shipping (the clock fence)."
+            ),
+            settle_rounds=4,
+        ),
+        Scenario(
+            name="mem_pressure",
+            timeline="mem_pressure()@3+4; hotspot(job-2)@3+3",
+            description=(
+                "The memory budget over the byte-accounted components "
+                "collapses while a workload hotspot churns the caches: "
+                "the governor sheds coarse-tiers-last (fleet caches "
+                "first), the accounted bytes come back under budget, "
+                "every shed is attributable from the governor's own "
+                "surface, and RSS growth stays bounded."
+            ),
+            settle_rounds=4,
+        ),
+        Scenario(
+            name="scrape_storm",
+            timeline="scrape_storm(120)@3+2",
+            description=(
+                "An aggressive keep-alive scrape fleet hammers the root's "
+                "serving tier: admission control holds open connections "
+                "at the cap (the storm costs rejected requests, never "
+                "FDs), a polite scraper's latency stays flat, and the "
+                "rejects are attributable from the reject counters."
+            ),
+            settle_rounds=3,
         ),
         Scenario(
             name="recv_outage",

@@ -129,6 +129,20 @@ _API_REJECT_RESPONSE = prerender_429(
     b'{"status": "error", "error": "too many concurrent api queries"}',
     "application/json",
 )
+# Admission-control rejects (scrape-storm defense, tpu_pod_exporter
+# ISSUE 10): a storm must cost rejected requests, never file descriptors
+# or handler-thread pile-up. Same pre-rendered-bytes discipline as the
+# scrape guard — the reject path runs per storm request.
+_CONN_REJECT_RESPONSE = prerender_429(
+    b"connection limit reached\n", "text/plain; charset=utf-8"
+)
+_CLIENT_REJECT_RESPONSE = prerender_429(
+    b"per-client request limit reached\n", "text/plain; charset=utf-8"
+)
+
+# Probe paths exempt from admission control: a scrape storm must never be
+# able to 429 kubelet's liveness/readiness probes into restarting the pod.
+_ADMISSION_EXEMPT_PATHS = ("/healthz", "/readyz")
 
 
 def accepts_openmetrics(accept: str) -> bool:
@@ -271,6 +285,20 @@ class _Handler(BaseHTTPRequestHandler):
     # tpu_exporter_scrape_duration_seconds histogram; must stay cheap, it
     # runs on the scrape path.
     scrape_observer = None
+    # Admission control (resource-pressure ISSUE 10): a hard cap on OPEN
+    # connections (keep-alive scrapers parked on handler threads are the
+    # FD/thread cost a storm inflicts on a thread-per-connection server)
+    # plus a per-client-IP concurrent-request cap. Over-cap connections
+    # are answered with the pre-rendered 429 + Retry-After and closed —
+    # except the kubelet probe paths, which always answer (a storm must
+    # not restart the pod). None/0 = disabled (the exporter app enables
+    # them via --max-open-connections / --max-requests-per-client).
+    conn_slots: threading.BoundedSemaphore | None = None
+    conn_stats = None   # {"open": int, "peak": int}, shared per server
+    conn_lock: threading.Lock | None = None
+    max_requests_per_client: int = 0
+    client_active = None  # {ip: concurrent requests}, shared per server
+    client_lock: threading.Lock | None = None
     # Slow-client write defense: per-connection socket SEND timeout
     # (SO_SNDTIMEO — receive-side keep-alive idling is unaffected). A
     # scraper that stops reading mid-body would otherwise pin this handler
@@ -288,6 +316,20 @@ class _Handler(BaseHTTPRequestHandler):
 
     def setup(self) -> None:
         super().setup()
+        # Connection admission: a slot is held for the connection's whole
+        # lifetime (keep-alive included). Over-cap connections still get
+        # ONE request handled — 429 for anything but the probe paths —
+        # then close; the cost of that bounded courtesy is one short-lived
+        # thread, not a parked one.
+        self._admitted = True
+        slots = self.conn_slots
+        if slots is not None:
+            self._admitted = slots.acquire(blocking=False)
+        if self.conn_stats is not None and self._admitted:
+            with self.conn_lock:
+                self.conn_stats["open"] += 1
+                if self.conn_stats["open"] > self.conn_stats["peak"]:
+                    self.conn_stats["peak"] = self.conn_stats["open"]
         t = self.client_write_timeout_s
         if t > 0:
             try:
@@ -300,6 +342,15 @@ class _Handler(BaseHTTPRequestHandler):
                 )
             except (OSError, ValueError, struct.error):
                 pass
+
+    def finish(self) -> None:
+        if getattr(self, "_admitted", True):
+            if self.conn_stats is not None:
+                with self.conn_lock:
+                    self.conn_stats["open"] -= 1
+            if self.conn_slots is not None:
+                self.conn_slots.release()
+        super().finish()
 
     def do_GET(self) -> None:  # noqa: N802 — stdlib API
         try:
@@ -317,6 +368,52 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _route_get(self) -> None:
         path, _, query = self.path.partition("?")
+        exempt = path in _ADMISSION_EXEMPT_PATHS
+        if not getattr(self, "_admitted", True):
+            # Over the connection cap: this connection never got a slot.
+            # Probe paths still answer (then close); everything else gets
+            # the pre-rendered 429 — the storm pays, kubelet never does.
+            self.close_connection = True
+            if not exempt:
+                self._count_admission_reject("connections")
+                self.wfile.write(_CONN_REJECT_RESPONSE)
+                return
+        cap = self.max_requests_per_client
+        client_key = None
+        if cap > 0 and not exempt:
+            client_key = self.client_address[0]
+            with self.client_lock:
+                cur = self.client_active.get(client_key, 0)
+                if cur >= cap:
+                    client_key = None
+                    over = True
+                else:
+                    self.client_active[client_key] = cur + 1
+                    over = False
+            if over:
+                self._count_admission_reject("client")
+                self.close_connection = True
+                self.wfile.write(_CLIENT_REJECT_RESPONSE)
+                return
+        try:
+            self._dispatch_get(path, query)
+        finally:
+            if client_key is not None:
+                with self.client_lock:
+                    cur = self.client_active.get(client_key, 1) - 1
+                    if cur <= 0:
+                        self.client_active.pop(client_key, None)
+                    else:
+                        self.client_active[client_key] = cur
+
+    def _count_admission_reject(self, cause: str) -> None:
+        if self.scrape_rejects is not None:
+            with self.scrape_rejects_lock:
+                self.scrape_rejects[cause] = (
+                    self.scrape_rejects.get(cause, 0) + 1
+                )
+
+    def _dispatch_get(self, path: str, query: str) -> None:
         if path == "/metrics":
             self._serve_metrics()
         elif path.startswith("/api/v1/"):
@@ -770,11 +867,19 @@ class MetricsServer:
         ready_detail_fn=None,
         client_write_timeout_s: float = 10.0,
         warm_fn=None,
+        max_open_connections: int = 0,
+        max_requests_per_client: int = 0,
     ) -> None:
-        # Both causes pre-seeded so the self-metric publishes a 0 series
-        # per cause from poll 1 (stable surface).
-        self.scrape_rejects = {"concurrency": 0, "rate": 0}
+        # Every cause pre-seeded so the self-metric publishes a 0 series
+        # per cause from poll 1 (stable surface). "connections"/"client"
+        # are the admission-control causes (0 unless the caps are on).
+        self.scrape_rejects = {
+            "concurrency": 0, "rate": 0, "connections": 0, "client": 0,
+        }
         self.write_timeouts = {"total": 0}
+        # Open-connection accounting for the admission cap (peak is the
+        # scrape-storm drill's bound witness).
+        self.conn_stats = {"open": 0, "peak": 0}
         handler = type(
             "BoundHandler",
             (_Handler,),
@@ -820,6 +925,16 @@ class MetricsServer:
                 "scrape_observer": (
                     staticmethod(scrape_observer) if scrape_observer else None
                 ),
+                "conn_slots": (
+                    threading.BoundedSemaphore(max_open_connections)
+                    if max_open_connections > 0
+                    else None
+                ),
+                "conn_stats": self.conn_stats,
+                "conn_lock": threading.Lock(),
+                "max_requests_per_client": max_requests_per_client,
+                "client_active": {},
+                "client_lock": threading.Lock(),
             },
         )
         self._httpd = _Server((host, port), handler)

@@ -1021,6 +1021,34 @@ class RootAggregator:
         self._store.swap(snap)
         self._round_hist.observe(round_dur)
 
+    # Rough per-entry retained cost of a stale-serve cache slot: dict
+    # entries + key tuples + float cells. Same estimate the memory budget
+    # sums and /debug/vars shows (the shared-numbers contract of
+    # tpu_pod_exporter.pressure).
+    _VIEW_ENTRY_EST_BYTES = 160
+
+    def stale_view_bytes(self) -> int:
+        """Estimated retained bytes of the stale-serve view cache
+        (``_last_views``) for the memory budget's component accounting."""
+        total = 0
+        for view, _wall in self._last_views.values():
+            total += self._VIEW_ENTRY_EST_BYTES * (
+                1
+                + len(view.slice_fields) + len(view.workload_fields)
+                + len(view.group_info) + len(view.target_up)
+                + len(view.target_breaker)
+            )
+        return total
+
+    def shed_stale_views(self) -> int:
+        """Memory-ladder hook: drop every cached stale-serve view (an
+        unreachable leaf's shard then degrades honestly instead of being
+        carried — memory pressure trumps continuity at this rung).
+        Returns the number of views dropped."""
+        n = len(self._last_views)
+        self._last_views.clear()
+        return n
+
     def ready_detail(self) -> dict:
         """/readyz detail hook (``server.MetricsServer ready_detail_fn``):
         the root keeps answering HTTP 200 through a partition — last-known
@@ -1052,6 +1080,7 @@ class RootAggregator:
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
             "stale_serve_s": self._stale_serve_s,
+            "stale_view_bytes": self.stale_view_bytes(),
             "stale_served_leaves": self._health[2],
             "partition_suspected": list(self._health[3]),
             "leaf_round_ts": dict(self._leaf_ts),

@@ -493,6 +493,43 @@ def persist_line(state_dir: str) -> str | None:
             f"wal {fmt_bytes(s['wal_bytes'])}) · {warm}")
 
 
+def pressure_line(state_dir: str) -> str | None:
+    """``pressure: …`` footer: per-resource ladder rung, bytes vs budget,
+    and last shed/recover instants — the operator-facing read of the
+    ``tpu_exporter_pressure_*`` surface, from the governor's on-disk
+    sidecar (mirrors the ``state-dir:``/``egress:`` footers). None when no
+    governor has run against this state dir."""
+    from tpu_pod_exporter.pressure import pressure_status_summary
+
+    doc = pressure_status_summary(state_dir)
+    if doc is None:
+        return None
+    parts = ["pressure:"]
+    now = time.time()
+    for resource in ("disk", "memory"):
+        ladder = doc.get(resource)
+        if not isinstance(ladder, dict):
+            continue
+        level = ladder.get("level", 0)
+        rung = ladder.get("rung") or "none"
+        usage = ladder.get("usage_bytes", 0)
+        budget = ladder.get("budget_bytes", 0)
+        cell = (f"{resource} rung {level}"
+                + (f" ({rung})" if level else "")
+                + f" · {fmt_bytes(usage)}"
+                + (f"/{fmt_bytes(budget)}" if budget else " (no budget)"))
+        shed = ladder.get("last_shed_wall") or 0
+        rec = ladder.get("last_recover_wall") or 0
+        if shed:
+            cell += f" · shed {max(now - shed, 0.0):.0f}s ago"
+        if rec:
+            cell += f" · recovered {max(now - rec, 0.0):.0f}s ago"
+        parts.append(cell)
+    if len(parts) == 1:
+        return None
+    return " ".join(parts[:1]) + " " + " | ".join(parts[1:])
+
+
 def egress_line(egress_url: str, egress_dir: str) -> str | None:
     """``egress: …`` footer: receiver/breaker state, backlog bytes/age,
     last-send latency — the operator-facing read of the
@@ -701,10 +738,13 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
         import json
 
         persist = None
+        pressure = None
         if cfg.state_dir:
             from tpu_pod_exporter.persist import state_dir_summary
+            from tpu_pod_exporter.pressure import pressure_status_summary
 
             persist = state_dir_summary(cfg.state_dir)
+            pressure = pressure_status_summary(cfg.state_dir)
         egress = None
         if cfg.egress_url:
             from tpu_pod_exporter.egress import egress_dir_summary
@@ -713,6 +753,7 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
         print(json.dumps({
             "accelerator": topo.accelerator,
             "persist": persist,
+            "pressure": pressure,
             "egress": egress,
             "slice_name": topo.slice_name,
             "host": topo.host,
@@ -757,6 +798,10 @@ def _run(cfg, topo, backend, attribution, scanner=None, as_json=False,
             print(line)
     if cfg.state_dir:
         line = persist_line(cfg.state_dir)
+        if line:
+            print()
+            print(line)
+        line = pressure_line(cfg.state_dir)
         if line:
             print()
             print(line)

@@ -331,6 +331,31 @@ class TraceStore:
             self.scrape_spans_total += 1
         return s
 
+    # Rough per-span retained cost (Span object + id strings + attrs dict)
+    # for the byte accounting the memory-pressure ladder reads. An
+    # estimate, not a measurement — but the SAME estimate the shed
+    # decision and /debug/vars both see, which is the contract.
+    SPAN_EST_BYTES = 640
+
+    def set_max_traces(self, n: int) -> None:
+        """Resize the trace ring in place, keeping the NEWEST traces — the
+        memory-pressure ladder's ``trace_halved`` rung. Reversible: a
+        larger ``n`` re-grows the bound (evicted traces stay gone)."""
+        n = max(int(n), 1)
+        with self._lock:
+            if n == self.max_traces:
+                return
+            kept = list(self._traces)[-n:]
+            self._traces = deque(kept, maxlen=n)
+            self._spans = sum(len(t.spans) for t in kept)
+            self.max_traces = n
+
+    def memory_bytes(self) -> int:
+        """Estimated retained bytes (trace ring + scrape-span ring) for
+        the memory budget's component accounting."""
+        with self._lock:
+            return (self._spans + len(self._scrapes)) * self.SPAN_EST_BYTES
+
     def last(self, n: int) -> list[PollTrace]:
         """Newest-last reference copy of up to the last ``n`` traces."""
         with self._lock:
