@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo clean
+.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -161,6 +161,22 @@ scenario-demo:
 pressure-demo:
 	python -m tpu_pod_exporter.pressure --demo
 	python -m tpu_pod_exporter.pressure --negative-control
+
+# Fleet TSDB-lite acceptance (deploy/RUNBOOK.md "Incident forensics from
+# the store"): two drills against the root-side store —
+#   retention: 7 simulated days at 1000 targets folded into disk-backed
+#           downsample tiers on a compressed timescale, a kill/replay
+#           restart mid-window, and a governor-enforced disk budget the
+#           ladder must answer with store_thin (finest tier shed first,
+#           counted; the 7-day coarse span must SURVIVE the shed, and
+#           rule-backed + per-target queries must answer the full window).
+#   query:  stored-rollup query p99 vs the cold two-level fan-out at 200
+#           real-HTTP targets — recording rules must beat the fan-out.
+# The scenario engine's store_continuity drill (make scenario-demo) covers
+# the restart+reshard boundary; CI also runs its --store off negative
+# control (the gap invariant must FAIL without the store).
+store-demo:
+	python -m tpu_pod_exporter.store --demo --state-dir store-demo-state
 
 native:
 	$(MAKE) -C native

@@ -93,6 +93,32 @@ def default_api_fetch(url: str, timeout_s: float,
     return doc
 
 
+def data_shape(route: str, merged: list) -> Any:
+    """Route → response ``data`` shape, mirroring the node-local answers
+    exactly so every parser that reads one exporter reads the fleet. THE
+    one implementation — the leaf plane, the root plane and the store-
+    backed plane all serve through it (shapes must not drift between
+    tiers; the cross-tier contract test pins it)."""
+    if route == "series":
+        return merged
+    if route == "query_range":
+        return {"resultType": "matrix", "result": merged}
+    return {"result": merged}
+
+
+def rows_of(route: str, env: Mapping[str, Any]) -> list:
+    """Inverse of :func:`data_shape`: the row list out of an envelope
+    (empty on malformed shapes — a bad upstream answer degrades, never
+    raises)."""
+    data = env.get("data")
+    if route == "series":
+        return data if isinstance(data, list) else []
+    if isinstance(data, dict):
+        rows = data.get("result")
+        return rows if isinstance(rows, list) else []
+    return []
+
+
 class _QueryCache:
     """Bounded LRU for query envelopes, keyed by (route, query, grid,
     generation). Entries are treated as immutable by every reader (the
@@ -340,6 +366,13 @@ class FleetQueryPlane:
             "status": "ok",
             "partial": partial,
             "route": route,
+            # Source attribution, shared across every /api/v1 tier: a
+            # fan-out answer is "live" by definition; the root's
+            # store-backed plane (tpu_pod_exporter.store) upgrades this
+            # to live|store|merged. One envelope contract — shapes must
+            # not drift between tiers (asserted by the shared-contract
+            # test in tests/test_store.py).
+            "source": "live",
             "data": self._data_shape(route, merged),
             "targets": statuses,
             "fleet": {
@@ -554,15 +587,7 @@ class FleetQueryPlane:
                 })
         return merged, duplicates
 
-    @staticmethod
-    def _data_shape(route: str, merged: list[dict]) -> Any:
-        """Mirror the node-local response shapes exactly, so every parser
-        that reads one exporter reads the fleet."""
-        if route == "series":
-            return merged
-        if route == "query_range":
-            return {"resultType": "matrix", "result": merged}
-        return {"result": merged}
+    _data_shape = staticmethod(data_shape)
 
     # -------------------------------------------------------------- exposition
 

@@ -249,17 +249,75 @@ class _TierRing:
         if self.n < self.cap:
             self.n += 1
 
+    def open_bucket(self) -> tuple | None:
+        """The open accumulator as one bucket tuple (None while empty) —
+        the same 11-field shape :func:`tier_items` yields. The fleet store
+        (``tpu_pod_exporter.store``) captures it just before a boundary
+        crossing finalizes it, which is exactly the record it persists."""
+        if self.bucket >= 0 and self.a_cnt > 0:
+            return (self.a_tmf, self.a_tml, self.a_twf, self.a_twl,
+                    self.a_min, self.a_max, self.a_sum,
+                    float(self.a_cnt), self.a_first, self.a_last,
+                    self.a_dpos)
+        return None
+
+    # ------------------------------------------------ disk-backed restore
+    # The wall-bucketed generalization (tpu_pod_exporter.store): a ring is
+    # rebuilt at boot from persisted finalized-bucket records, then keeps
+    # accumulating live — push() inserts a finalized bucket directly,
+    # replacing the newest retained bucket when both cover the SAME wall
+    # bucket (a re-finalization record written after a restart merged new
+    # samples into a restored accumulator supersedes the pre-crash record,
+    # so replay is idempotent and never yields duplicate buckets), and
+    # pop_to_accumulator() re-opens the newest restored bucket so the
+    # first post-restart samples of the same wall bucket MERGE exactly
+    # (every accumulator field is present in the stored bucket) instead of
+    # forking a twin bucket.
+
+    def _store_at(self, i: int, b: tuple) -> None:
+        (self.tmf[i], self.tml[i], self.twf[i], self.twl[i],
+         self.vmin[i], self.vmax[i], self.vsum[i], self.vcnt[i],
+         self.vfirst[i], self.vlast[i], self.dpos[i]) = b
+
+    def push(self, b: tuple) -> None:
+        """Insert one FINALIZED bucket (oldest-first replay order); a
+        bucket covering the same wall bucket as the newest retained one
+        REPLACES it (see the restore notes above)."""
+        bid = int(b[2] // self.step)
+        if self.n:
+            j = (self.head - 1) % self.cap
+            if int(self.twf[j] // self.step) == bid:
+                self._store_at(j, b)
+                return
+        self._store_at(self.head, b)
+        self.head = (self.head + 1) % self.cap
+        if self.n < self.cap:
+            self.n += 1
+
+    def pop_to_accumulator(self) -> None:
+        """Move the NEWEST finalized bucket back into the open accumulator
+        (boot-time restore tail): post-restart samples landing in the same
+        wall bucket then merge into it exactly."""
+        if not self.n:
+            return
+        i = (self.head - 1) % self.cap
+        self.head = i
+        self.n -= 1
+        (self.a_tmf, self.a_tml, self.a_twf, self.a_twl,
+         self.a_min, self.a_max, self.a_sum, cnt,
+         self.a_first, self.a_last, self.a_dpos) = (
+            self.tmf[i], self.tml[i], self.twf[i], self.twl[i],
+            self.vmin[i], self.vmax[i], self.vsum[i], self.vcnt[i],
+            self.vfirst[i], self.vlast[i], self.dpos[i])
+        self.a_cnt = int(cnt)
+        self.bucket = int(self.a_twf // self.step)
+
     # Query-side copy, called UNDER the store lock (same raw-slice
     # discipline as HistoryStore._rows_for): finalized buckets as array
     # slices plus the open accumulator as one tuple; per-bucket Python
     # tuples are built outside the lock by _tier_items.
     def copy(self) -> tuple:
-        open_bucket = None
-        if self.bucket >= 0 and self.a_cnt > 0:
-            open_bucket = (self.a_tmf, self.a_tml, self.a_twf, self.a_twl,
-                           self.a_min, self.a_max, self.a_sum,
-                           float(self.a_cnt), self.a_first, self.a_last,
-                           self.a_dpos)
+        open_bucket = self.open_bucket()
         return (self.step, self.cap, self.n, self.head,
                 self.tmf[:], self.tml[:], self.twf[:], self.twl[:],
                 self.vmin[:], self.vmax[:], self.vsum[:], self.vcnt[:],
@@ -308,6 +366,74 @@ def _tier_items(copy: tuple) -> list[tuple]:
     if open_bucket is not None:
         items.append(open_bucket)
     return items
+
+
+# Public names for the wall-bucketed tier machinery the root-side fleet
+# store (tpu_pod_exporter.store) builds on: the ring itself, the copied-
+# ring walker, and the two query folds extracted below. One implementation
+# of bucket semantics — the store must answer exactly like a node ring.
+TierRing = _TierRing
+tier_items = _tier_items
+
+
+def align_grid(
+    points: Sequence[tuple[float, float]],
+    start: float,
+    end: float,
+    step: float,
+    lookback: float,
+) -> list[list[float]]:
+    """Align time-ordered ``(t_wall, value)`` points to the grid ``start,
+    start+step, …, end``: each grid point carries the most recent sample at
+    or before it, within ``lookback`` seconds (so a long-dead series does
+    not project forward forever). Samples just BEFORE ``start`` are still
+    eligible for the left-edge grid points — filtering them out would fake
+    a gap at the start of an incident window. One forward pointer walk."""
+    raw = [(tw, v) for (tw, v) in points if tw <= end]
+    aligned: list[list[float]] = []
+    i = -1
+    t = start
+    while t <= end + 1e-9:
+        while i + 1 < len(raw) and raw[i + 1][0] <= t:
+            i += 1
+        if i >= 0 and t - raw[i][0] <= lookback:
+            aligned.append([t, raw[i][1]])
+        t += step
+    return aligned
+
+
+def fold_tier_window(
+    buckets: Sequence[tuple], counter: bool
+) -> dict[str, float | int | None]:
+    """Window statistics recomputed EXACTLY from tier buckets (oldest
+    first): min/max/first/last direct, mean via sum/count (weighted —
+    bucket sample counts differ), and the counter rate from within-bucket
+    positive-delta sums plus cross-bucket boundary deltas rebuilt from
+    adjacent buckets' first/last values, so reset tolerance survives
+    downsampling. The shared fold behind HistoryStore.window_stats and the
+    fleet store's window queries."""
+    nsamples = int(sum(b[7] for b in buckets))
+    stats: dict[str, float | int | None] = {
+        "min": min(b[4] for b in buckets),
+        "max": max(b[5] for b in buckets),
+        "mean": sum(b[6] for b in buckets) / nsamples,
+        "first": buckets[0][8],
+        "last": buckets[-1][9],
+        "first_t": buckets[0][2],
+        "last_t": buckets[-1][3],
+        "samples": nsamples,
+        "rate": None,
+    }
+    if counter and nsamples >= 2:
+        dt = buckets[-1][1] - buckets[0][0]
+        if dt > 0:
+            gained = sum(b[10] for b in buckets)
+            for prev, cur in zip(buckets, buckets[1:]):
+                d = cur[8] - prev[9]  # boundary: first - prev last
+                if d > 0:
+                    gained += d
+            stats["rate"] = gained / dt
+    return stats
 
 
 class _Series:
@@ -830,27 +956,11 @@ class HistoryStore:
                     for b in _tier_items(payload)
                 ]
             if step > 0:
-                # Grid alignment carries the most recent sample at or
-                # before each point, so samples just BEFORE `start` are
-                # still eligible for the left-edge grid points (within the
-                # lookback) — filtering them out would fake a gap at the
-                # start of an incident window.
-                raw = [(tw, v) for (tw, v) in points if tw <= end]
                 # Lookback floor tracks the bucket width on tier-backed
                 # answers: a 60 s bucket's single point must carry grid
                 # points across its whole bucket, not just 10 s of it.
                 lookback = max(2.0 * step, 2.0 * tier_step, 10.0)
-                aligned: list[list[float]] = []
-                i = -1
-                t = start
-                # one forward pointer walk: points are time-ordered
-                while t <= end + 1e-9:
-                    while i + 1 < len(raw) and raw[i + 1][0] <= t:
-                        i += 1
-                    if i >= 0 and t - raw[i][0] <= lookback:
-                        aligned.append([t, raw[i][1]])
-                    t += step
-                values = aligned
+                values = align_grid(points, start, end, step, lookback)
             else:
                 values = [
                     [tw, v] for (tw, v) in points if start <= tw <= end
@@ -924,27 +1034,7 @@ class HistoryStore:
                 ]  # bucket's last sample inside the window
                 if not buckets:
                     continue
-                nsamples = int(sum(b[7] for b in buckets))
-                stats = {
-                    "min": min(b[4] for b in buckets),
-                    "max": max(b[5] for b in buckets),
-                    "mean": sum(b[6] for b in buckets) / nsamples,
-                    "first": buckets[0][8],
-                    "last": buckets[-1][9],
-                    "first_t": buckets[0][2],
-                    "last_t": buckets[-1][3],
-                    "samples": nsamples,
-                    "rate": None,
-                }
-                if counter and nsamples >= 2:
-                    dt = buckets[-1][1] - buckets[0][0]
-                    if dt > 0:
-                        gained = sum(b[10] for b in buckets)
-                        for prev, cur in zip(buckets, buckets[1:]):
-                            d = cur[8] - prev[9]  # boundary: first - prev last
-                            if d > 0:
-                                gained += d
-                        stats["rate"] = gained / dt
+                stats = fold_tier_window(buckets, counter)
             out.append({
                 "metric": metric, "labels": dict(labels), "stats": stats,
                 "tier": tier_step, "last_sample_wall_ts": last_wall,

@@ -684,7 +684,8 @@ class _ShardSim:
                  leaf_breaker_backoff_max_s: float = 60.0,
                  root_breaker_backoff_s: float = 10.0,
                  root_breaker_backoff_max_s: float = 120.0,
-                 n_slices: int = 8, query_plane: bool = False) -> None:
+                 n_slices: int = 8, query_plane: bool = False,
+                 store_factory=None) -> None:
         import os
 
         from tpu_pod_exporter.aggregate import SliceAggregator, default_fetch
@@ -692,7 +693,6 @@ class _ShardSim:
         from tpu_pod_exporter.metrics import SnapshotStore
         from tpu_pod_exporter.persist import ShardMapFile
         from tpu_pod_exporter.shard import (
-            RootAggregator,
             ShardMap,
             default_shards,
         )
@@ -744,16 +744,26 @@ class _ShardSim:
                 lambda t: self.leaf_addr_of.get(t, "leaf:?"),
                 default_fetch,
             )
-        self.root = RootAggregator(
-            self.topology, self.root_store, timeout_s=timeout_s,
+        # Root construction goes through _build_root so root_restart
+        # events (the store-continuity drill) can rebuild a FRESH root —
+        # and a fresh FleetStore replaying the same dir — mid-run. The
+        # SnapshotStore is shared across rebuilds: the engine's root
+        # MetricsServer keeps serving the last published (stale) view
+        # through the downtime, exactly like a real root's kubelet gap.
+        self._store_factory = store_factory
+        self._root_kwargs = dict(
+            timeout_s=timeout_s,
             fetch=root_fetch,
-            targets_file=self.targets_file, shard_map=self.smap,
-            shard_map_store=ShardMapFile(
-                os.path.join(state_root, "root-shardmap.json")),
+            targets_file=self.targets_file,
+            shard_map=self.smap,
             breaker_backoff_s=root_breaker_backoff_s,
             breaker_backoff_max_s=root_breaker_backoff_max_s,
             stale_serve_s=stale_serve_s,
         )
+        self._root_shardmap_path = os.path.join(
+            state_root, "root-shardmap.json")
+        self.root_down = False
+        self.root = self._build_root()
         # The correctness oracle: ONE flat aggregator over the same
         # targets file (breakers off so it re-scrapes dead targets every
         # round, matching what "a target is down" means to the fleet).
@@ -765,6 +775,40 @@ class _ShardSim:
         self._pool = None
 
     # -------------------------------------------------------------- plumbing
+
+    def _build_root(self):
+        from tpu_pod_exporter.persist import ShardMapFile
+        from tpu_pod_exporter.shard import RootAggregator
+
+        fleet_store = (self._store_factory()
+                       if self._store_factory is not None else None)
+        return RootAggregator(
+            self.topology, self.root_store,
+            shard_map_store=ShardMapFile(self._root_shardmap_path),
+            fleet_store=fleet_store,
+            **self._root_kwargs,
+        )
+
+    def kill_root(self) -> None:
+        """SIGKILL-shaped root death: no graceful close (a killed process
+        force-saves nothing — the store must prove its per-append WAL
+        durability alone). Worker threads are reaped; the store's file
+        handles close (flushed appends are already in the page cache,
+        which survives a process kill)."""
+        if self.root_down:
+            return
+        self.root_down = True
+        self.root._pool.shutdown(wait=False)
+        if self.root._fleet_store is not None:
+            for buf in self.root._fleet_store._buffers:
+                buf.close()
+
+    def restart_root(self) -> None:
+        """A fresh root on the same state dirs; with a store factory the
+        fresh FleetStore replays its tiers from disk — the continuity
+        boundary the store_continuity drill queries across."""
+        self.root = self._build_root()
+        self.root_down = False
 
     def write_targets(self, targets) -> None:
         import os
@@ -826,7 +870,8 @@ class _ShardSim:
             leaf.begin_round()
         list(self._pool.map(lambda l: l.agg.poll_once(), live))
         t1 = time.perf_counter()
-        self.root.poll_once()
+        if not self.root_down:
+            self.root.poll_once()
         t2 = time.perf_counter()
         self.round_ref[0] = r + 1
         return {"leaf_tier_s": t1 - t0, "root_s": t2 - t1,

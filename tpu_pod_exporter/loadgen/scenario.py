@@ -88,11 +88,15 @@ class _Run:
     # (the scrape_storm drill's bound; generous for every other scenario).
     STORM_CONN_CAP = 32
     STORM_CLIENT_CAP = 8
+    # Store tiers scaled to paced drill rounds (scenario.round_pause_s
+    # keeps one finest bucket finalizing per round).
+    STORE_TIERS = "0.25:600,2.5:600"
+    STORE_FINEST_STEP = 0.25
 
     def __init__(self, scn: Scenario, n_targets: int, shards: int,
                  chips: int, state_root: str, seed: int,
                  stale_serve_s: float = 30.0,
-                 governor: bool = True) -> None:
+                 governor: bool = True, store: bool = True) -> None:
         from tpu_pod_exporter.egress import (
             RemoteWriteShipper,
             aggregator_egress_metrics,
@@ -100,7 +104,6 @@ class _Run:
             default_send,
         )
         from tpu_pod_exporter.server import MetricsServer
-        from tpu_pod_exporter.shard import RootQueryPlane
 
         self.scn = scn
         self.events = scn.events()
@@ -109,6 +112,14 @@ class _Run:
         os.makedirs(state_root, exist_ok=True)
         self.net = PartitionState(seed=seed)
         self.stale_serve_s = stale_serve_s
+        # Fleet TSDB-lite under the root (store_continuity drill): tiers
+        # scaled to subsecond drill rounds, one recording rule so the
+        # rule-backed-query half of the invariant is exercised. --store
+        # off is the drill's NEGATIVE CONTROL: the continuity invariant
+        # still runs and must fail on the boundary gap.
+        self.store = None
+        self.store_on = store and scn.uses_store
+        self.store_dir = os.path.join(state_root, "store")
         # Breaker backoffs scaled to subsecond drill rounds (production
         # defaults are tens of seconds): a healed partition's quarantined
         # targets must be re-admitted within the settle budget — the
@@ -119,16 +130,19 @@ class _Run:
             leaf_breaker_backoff_s=0.4, leaf_breaker_backoff_max_s=0.8,
             root_breaker_backoff_s=0.4, root_breaker_backoff_max_s=0.8,
             n_slices=4, query_plane=True,
+            store_factory=self._make_store if self.store_on else None,
         )
         self.membership: list[str] = list(self.sim.farm.targets())
         # Root /readyz over real HTTP: partition-aware degradation is an
         # operator contract, so it is asserted through the wire. With the
         # governor on, the serving tier also carries the admission caps
-        # the scrape_storm drill storms against.
+        # the scrape_storm drill storms against. Hooks dereference
+        # self.sim.root LATE (lambdas): a root_restart event swaps the
+        # root instance mid-run.
         self.governor_on = governor
         self.root_server = MetricsServer(
             self.sim.root_store, host="127.0.0.1", port=0,
-            ready_detail_fn=self.sim.root.ready_detail,
+            ready_detail_fn=lambda: self.sim.root.ready_detail(),
             max_open_connections=self.STORM_CONN_CAP if governor else 0,
             max_requests_per_client=self.STORM_CLIENT_CAP if governor else 0,
         )
@@ -143,17 +157,9 @@ class _Run:
                 hostport = ""
             return port_to_leaf.get(hostport, "leaf:?")
 
-        from tpu_pod_exporter.fleet import default_api_fetch
-
-        def _plain_api(url: str, timeout_s: float) -> dict:
-            return default_api_fetch(url, timeout_s)
-
-        self.plane = RootQueryPlane(
-            self.sim.topology, timeout_s=2.5,
-            fetch=PartitionedFetch(self.net, "root", _leaf_of_url,
-                                   _plain_api),
-            leaf_breakers=self.sim.root._breakers,
-        )
+        self._leaf_of_url = _leaf_of_url
+        self.plane = None
+        self._build_planes()
         # Egress: the root's rollups ship to a ChaosReceiver through a
         # partitionable sender; the ledger is the zero-loss oracle.
         self.receiver = None
@@ -197,10 +203,26 @@ class _Run:
                     lambda: self.shipper.set_disk_pressure(True),
                     lambda: self.shipper.set_disk_pressure(False),
                 )
+            if self.store is not None:
+                # store_thin AFTER egress compaction (acked egress bytes
+                # are free to reclaim; store buckets are answerable
+                # history) — coarse store tiers shed never. The getter
+                # dereferences self.store late: root_restart swaps the
+                # instance (which re-applies the pressure hook, see
+                # _make_store). One wiring path with production
+                # (pressure.register_store_rungs), not a hand-rolled twin.
+                from tpu_pod_exporter.pressure import register_store_rungs
+
+                register_store_rungs(self.gov, self.store,
+                                     store_fn=lambda: self.store)
             self.gov.register_memory_component(
                 "fleet_caches", self._leaf_cache_bytes)
             self.gov.register_memory_component(
-                "stale_views", self.sim.root.stale_view_bytes)
+                "stale_views",
+                # Late deref, like every root hook: a root_restart swaps
+                # the instance, and accounting a dead root's frozen views
+                # would make the shed rung free nothing measurable.
+                lambda: self.sim.root.stale_view_bytes())
             self.gov.add_memory_rung(
                 "fleet_cache",
                 lambda: self._set_leaf_caches(False),
@@ -231,8 +253,70 @@ class _Run:
         # bounded by the settle loop, not an instant flip.
         self.recovering_leaves: set[str] = set()
         self.restart_batches: dict[int, tuple[int, ...]] = {}
+        # store_continuity boundary stamps (root_restart event hooks).
+        self.start_wall = 0.0
+        self.kill_wall = 0.0
+        self.restart_wall = 0.0
         self.trace: list[dict] = []
         self.problems: list[str] = []
+
+    # --------------------------------------------------------- store helpers
+
+    def _make_store(self):
+        """FleetStore factory handed to _ShardSim: called at boot AND by
+        restart_root — the fresh instance replays the same dir, which IS
+        the continuity under test."""
+        from tpu_pod_exporter.store import FleetStore, parse_rules
+
+        rules = parse_rules(
+            "scenario:hbm:by_slice = sum("
+            + schema.TPU_SLICE_HBM_USED_BYTES.name + ") by (slice_name)\n")
+        s = FleetStore(self.store_dir, tiers=self.STORE_TIERS, rules=rules)
+        s.open()
+        # Hooks and held rung state live on the instance: a restart-
+        # swapped store must rejoin the governor's ENOSPC fault window
+        # AND re-apply a held store_thin rung (register_store_rungs
+        # wired the first instance; the getter covers the rung
+        # callbacks, this covers per-instance state — the documented
+        # store_fn contract).
+        gov = getattr(self, "gov", None)
+        if gov is not None:
+            s.set_pressure_hook(gov.report_io_error)
+            gs = gov.stats()["disk"]
+            if "store_thin" in gs["rungs"][:gs["level"]]:
+                s.set_thin(True)
+        self.store = s
+        return s
+
+    def _build_planes(self) -> None:
+        """(Re)build the two-level query plane — and its store-backed
+        front when a store is attached. Called at boot and after a
+        root_restart (the fresh root owns fresh leaf breakers and a fresh
+        store instance)."""
+        from tpu_pod_exporter.shard import RootQueryPlane
+
+        if self.plane is not None:
+            try:
+                self.plane.close()
+            except Exception:  # noqa: BLE001 — rebuild must proceed
+                pass
+        from tpu_pod_exporter.fleet import default_api_fetch
+
+        def _plain_api(url: str, timeout_s: float) -> dict:
+            return default_api_fetch(url, timeout_s)
+
+        inner = RootQueryPlane(
+            self.sim.topology, timeout_s=2.5,
+            fetch=PartitionedFetch(self.net, "root", self._leaf_of_url,
+                                   _plain_api),
+            leaf_breakers=self.sim.root._breakers,
+        )
+        if self.store is not None:
+            from tpu_pod_exporter.store import StoreQueryPlane
+
+            self.plane = StoreQueryPlane(inner, self.store)
+        else:
+            self.plane = inner
 
     # ------------------------------------------------------- pressure helpers
 
@@ -372,6 +456,12 @@ class _Run:
             self.storm.start()
         elif ev.kind == "clock_step":
             self.clock.step(ev.step_s)
+        elif ev.kind == "root_restart":
+            # SIGKILL-shaped: the serving tier keeps answering the stale
+            # snapshot (real kubelet gap), leaves keep polling, the store
+            # stops appending — the dead window the store must later fill.
+            self.kill_wall = time.time()
+            self.sim.kill_root()
 
     def _end_event(self, ev: ScenarioEvent) -> None:
         farm = self.sim.farm
@@ -408,6 +498,13 @@ class _Run:
         elif ev.kind == "scrape_storm":
             if self.storm is not None:
                 self.storm.stop()
+        elif ev.kind == "root_restart":
+            # Fresh root; with a store factory the fresh FleetStore
+            # replays the same dir — planes rebuild onto the new
+            # instances (breakers + store identity changed).
+            self.sim.restart_root()
+            self.restart_wall = time.time()
+            self._build_planes()
 
     def _tick_event(self, ev: ScenarioEvent, r: int) -> None:
         """Per-round continuation for windowed events."""
@@ -461,6 +558,7 @@ class _Run:
     def run(self) -> dict:
         result: dict = {"scenario": self.scn.name,
                         "timeline": self.scn.timeline, "ok": False}
+        self.start_wall = time.time()
         try:
             for r in range(self.rounds):
                 for ev in self.events:
@@ -487,6 +585,8 @@ class _Run:
                     result["failed_round"] = r
                     result["problems"] = self.problems[:8]
                     return result
+                if self.scn.round_pause_s:
+                    time.sleep(self.scn.round_pause_s)
             ok = self._finish(result)
             result["ok"] = ok and not self.problems
             if self.problems:
@@ -879,6 +979,9 @@ class _Run:
                 "heal, or a pressure ladder stuck above level 0?)")
             return False
 
+        if self.scn.name == "store_continuity":
+            self._check_store_continuity()
+
         # /readyz healthy again, over the wire.
         doc = _get_json(f"http://127.0.0.1:{self.root_server.port}/readyz")
         result["readyz_state"] = doc.get("state")
@@ -953,6 +1056,83 @@ class _Run:
                     f"{ledger['duplicate_samples']} duplicate samples")
         return not self.problems
 
+    def _check_store_continuity(self) -> None:
+        """The store_continuity drill's boundary invariant, run with the
+        store ON and OFF alike (off is the negative control: the same
+        checks must then FAIL on the gap). A bucket-sample query (step=0 —
+        no grid carry-forward masking holes) over [run start, now] must
+        have real points on BOTH sides of the root's dead window, with no
+        internal hole wider than the downtime itself, sources honest per
+        row, and the recording-rule series answerable store-only."""
+        problems: list[str] = []
+        rollup = schema.TPU_SLICE_HBM_USED_BYTES.name
+        end = time.time()
+        try:
+            env = self.plane.query_range(rollup, start=self.start_wall,
+                                         end=end, step=0.0)
+        except Exception as e:  # noqa: BLE001 — a broken plane IS the finding
+            self.problems.append(f"store continuity: boundary query "
+                                 f"failed: {e}")
+            return
+        rows = env.get("data", {}).get("result", [])
+        pts = sorted(
+            float(t) for row in rows if isinstance(row, dict)
+            for t, _v in (row.get("values") or [])
+        )
+        downtime = max(self.restart_wall - self.kill_wall, 0.1)
+        tag = "" if self.store is not None else " [store OFF]"
+        if not any(t <= self.kill_wall for t in pts):
+            problems.append(
+                f"store continuity{tag}: no samples before the root kill "
+                f"— the dead window is a gap, nothing fills it")
+        if not any(t >= self.restart_wall for t in pts):
+            problems.append(
+                f"store continuity{tag}: no samples after the restart")
+        allowed = downtime + 2.0 * self.STORE_FINEST_STEP + 2.0
+        for a, b in zip(pts, pts[1:]):
+            if b - a > allowed:
+                problems.append(
+                    f"store continuity{tag}: {b - a:.1f}s hole in the "
+                    f"boundary query (allowed {allowed:.1f}s = downtime "
+                    f"+ bucket slack)")
+                break
+        if self.store is not None:
+            bad = [row for row in rows
+                   if row.get("source") not in ("live", "store")]
+            if bad:
+                problems.append(
+                    f"store continuity: {len(bad)} row(s) without honest "
+                    f"source attribution")
+            store_pts = [
+                float(t) for row in rows if row.get("source") == "store"
+                for t, _v in (row.get("values") or [])
+            ]
+            if not any(t <= self.kill_wall for t in store_pts):
+                problems.append(
+                    "store continuity: pre-kill coverage not attributed "
+                    "source=store (who answered it?)")
+            if env.get("source") not in ("merged", "store"):
+                problems.append(
+                    f"store continuity: envelope source "
+                    f"{env.get('source')!r} despite store fills")
+            # Store-only + recording-rule halves: ?source=store must
+            # answer alone, and the rule series must live in the store.
+            senv = self.plane.query_range(rollup, start=self.start_wall,
+                                          end=end, step=0.0,
+                                          source="store")
+            srows = senv.get("data", {}).get("result", [])
+            if not srows or any(
+                    row.get("source") != "store" for row in srows):
+                problems.append("store continuity: ?source=store did not "
+                                "answer store-only")
+            renv = self.plane.query_range("scenario:hbm:by_slice",
+                                          start=self.start_wall, end=end,
+                                          step=0.5, source="store")
+            if not renv.get("data", {}).get("result"):
+                problems.append("store continuity: recording-rule series "
+                                "not served from the store")
+        self.problems.extend(problems)
+
     def _await_drain(self, timeout_s: float = 20.0) -> bool:
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
@@ -983,16 +1163,18 @@ class _Run:
 
 def run_scenarios(names: list[str], n_targets: int, shards: int,
                   chips: int, state_root: str, seed: int,
-                  governor: bool = True) -> dict:
+                  governor: bool = True, store: bool = True) -> dict:
     """Run the named scenarios back to back, each on a fresh stack (own
     state dir under ``state_root``); returns the summary dict the demo
     prints and writes as the CI artifact. ``governor=False`` is the
-    pressure drills' negative control: the invariants still run, and the
-    run is EXPECTED to fail them."""
+    pressure drills' negative control and ``store=False`` the
+    store-continuity drill's: the invariants still run, and the run is
+    EXPECTED to fail them."""
     os.makedirs(state_root, exist_ok=True)
     summary: dict = {
         "ok": True, "targets": n_targets, "shards": shards,
-        "seed": seed, "governor": governor, "scenarios": {},
+        "seed": seed, "governor": governor, "store": store,
+        "scenarios": {},
     }
     all_traces: dict[str, list] = {}
     for name in names:
@@ -1000,7 +1182,7 @@ def run_scenarios(names: list[str], n_targets: int, shards: int,
         t0 = time.monotonic()
         run = _Run(scn, n_targets, shards, chips,
                    os.path.join(state_root, name), seed,
-                   governor=governor)
+                   governor=governor, store=store)
         result = run.run()
         result["wall_s"] = round(time.monotonic() - t0, 2)
         all_traces[name] = run.trace
@@ -1053,6 +1235,12 @@ def main(argv: list[str] | None = None) -> int:
                         "governor, no admission caps — the invariants "
                         "still run and the drill is expected to FAIL "
                         "(CI asserts the non-zero exit)")
+    p.add_argument("--store", default="on", choices=("on", "off"),
+                   help="off = the store_continuity drill's NEGATIVE "
+                        "CONTROL: no fleet store under the root — the "
+                        "boundary-gap invariant still runs and the drill "
+                        "is expected to FAIL (CI asserts the non-zero "
+                        "exit)")
     p.add_argument("--log-level", default="warning")
     ns = p.parse_args(argv)
     _utils.setup_logging(ns.log_level)
@@ -1073,10 +1261,13 @@ def main(argv: list[str] | None = None) -> int:
     print(f"scenario engine: {len(names)} scenario(s), {ns.targets} "
           f"targets / {ns.shards} HA shards, seed {ns.seed}"
           + (" — GOVERNOR OFF (negative control)"
-             if ns.governor == "off" else ""))
+             if ns.governor == "off" else "")
+          + (" — STORE OFF (negative control)"
+             if ns.store == "off" else ""))
     summary = run_scenarios(names, ns.targets, ns.shards, ns.chips,
                             ns.state_root, ns.seed,
-                            governor=ns.governor == "on")
+                            governor=ns.governor == "on",
+                            store=ns.store == "on")
     if not summary["ok"]:
         failed = [n for n, r in summary["scenarios"].items()
                   if not r["ok"]]

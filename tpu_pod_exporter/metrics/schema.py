@@ -1135,6 +1135,103 @@ ROOT_SPECS: tuple[MetricSpec, ...] = (
     TPU_ROOT_ROUND_DURATION_SECONDS,
 )
 
+# --- Root fleet store (tpu_pod_exporter.store) -------------------------------
+# Emitted only while a FleetStore is attached to the root (--store-dir) —
+# conditional surface, same rule as PERSIST/EGRESS_SPECS. The store's
+# health must be auditable from the exposition alone: a full/refusing disk
+# shows as append failures (TpuRootStoreAppendFailing), pressure shedding
+# as thinned=1 + reason="shed" drops (TpuRootStoreDiskPressure), and a
+# stalled store as a growing last-append age.
+
+TPU_ROOT_STORE_APPENDED_SAMPLES_TOTAL = MetricSpec(
+    name="tpu_root_store_appended_samples_total",
+    help="Samples folded into the root fleet store's downsample tiers since start (merged rollups + per-target series + recording-rule outputs, once per root merge round).",
+    type=COUNTER,
+)
+
+TPU_ROOT_STORE_APPEND_FAILURES_TOTAL = MetricSpec(
+    name="tpu_root_store_append_failures_total",
+    help="Store WAL appends that the filesystem refused (ENOSPC, I/O errors). The in-memory tiers keep serving; durability of the failed records is lost — TpuRootStoreAppendFailing alerts on a sustained rate.",
+    type=COUNTER,
+)
+
+TPU_ROOT_STORE_SERIES = MetricSpec(
+    name="tpu_root_store_series",
+    help="Series currently held by the root fleet store across all downsample tiers.",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_TIER_BUCKETS = MetricSpec(
+    name="tpu_root_store_tier_buckets",
+    help="Finalized downsample buckets currently retained per store tier (open accumulator buckets included). 0 for a tier the disk ladder's store_thin rung has shed.",
+    type=GAUGE,
+    label_names=("tier",),
+)
+
+TPU_ROOT_STORE_SPAN_SECONDS = MetricSpec(
+    name="tpu_root_store_span_seconds",
+    help="Answerable retention span of the root fleet store — how far back a query can currently reach (the widest tier's newest-minus-oldest bucket wall time). Sized in days by --store-tiers.",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_DISK_BYTES = MetricSpec(
+    name="tpu_root_store_disk_bytes",
+    help="On-disk bytes of the store's pending WAL records across all tier buffers under --store-dir (what the disk ladder's budget measures).",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_MEMORY_BYTES = MetricSpec(
+    name="tpu_root_store_memory_bytes",
+    help="In-memory bytes of the store's tier rings (preallocated per series per enabled tier) — the number the store registers with the memory-pressure ladder.",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_DROPPED_RECORDS_TOTAL = MetricSpec(
+    name="tpu_root_store_dropped_records_total",
+    help="Store WAL records removed WITHOUT being replayable, by reason: 'shed' (the disk ladder's store_thin rung dropped the finest tier — policy, never silent), 'retention' (records past the tier's own span — the steady-state trim), 'corrupt' (torn/scrambled records truncated at boot).",
+    type=COUNTER,
+    label_names=("reason",),
+)
+
+TPU_ROOT_STORE_RULES = MetricSpec(
+    name="tpu_root_store_rules",
+    help="Recording rules loaded from --store-rules (each precomputes one per-slice/per-workload aggregate into its own stored series every root round).",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_RULE_FAILURES_TOTAL = MetricSpec(
+    name="tpu_root_store_rule_failures_total",
+    help="Recording-rule evaluations that raised (bad samples, arithmetic on absent families). The failing rule is skipped for that round; the others still evaluate.",
+    type=COUNTER,
+)
+
+TPU_ROOT_STORE_LAST_APPEND_TIMESTAMP_SECONDS = MetricSpec(
+    name="tpu_root_store_last_append_timestamp_seconds",
+    help="Unix timestamp of the store's most recent successful round append. A growing age with the root up means the store stopped ingesting — see TpuRootStoreAppendFailing.",
+    type=GAUGE,
+)
+
+TPU_ROOT_STORE_THINNED = MetricSpec(
+    name="tpu_root_store_thinned",
+    help="1 while the disk ladder's store_thin rung holds the store's finest tier shed (coarse tiers keep answering long windows); 0 when all tiers ingest.",
+    type=GAUGE,
+)
+
+STORE_SPECS: tuple[MetricSpec, ...] = (
+    TPU_ROOT_STORE_APPENDED_SAMPLES_TOTAL,
+    TPU_ROOT_STORE_APPEND_FAILURES_TOTAL,
+    TPU_ROOT_STORE_SERIES,
+    TPU_ROOT_STORE_TIER_BUCKETS,
+    TPU_ROOT_STORE_SPAN_SECONDS,
+    TPU_ROOT_STORE_DISK_BYTES,
+    TPU_ROOT_STORE_MEMORY_BYTES,
+    TPU_ROOT_STORE_DROPPED_RECORDS_TOTAL,
+    TPU_ROOT_STORE_RULES,
+    TPU_ROOT_STORE_RULE_FAILURES_TOTAL,
+    TPU_ROOT_STORE_LAST_APPEND_TIMESTAMP_SECONDS,
+    TPU_ROOT_STORE_THINNED,
+)
+
 # The rollup surface the aggregator's remote-write egress ships
 # (tpu_pod_exporter.egress): the slice/multislice/workload rollups plus
 # per-target up — the "what is the fleet doing" set a central TSDB wants,

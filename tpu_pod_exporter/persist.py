@@ -63,7 +63,7 @@ import zlib
 from array import array
 from collections import deque
 from dataclasses import dataclass, field
-from typing import IO, TYPE_CHECKING, Any, Callable, Mapping
+from typing import IO, TYPE_CHECKING, Any, Callable, Iterator, Mapping
 
 if TYPE_CHECKING:  # typing only — no runtime import cost
     from tpu_pod_exporter.history import HistoryStore
@@ -1240,6 +1240,42 @@ class WalBuffer:
             if index >= len(self._entries):
                 return None
         return self._read_entry(index)
+
+    def iter_payloads(self) -> Iterator[bytes]:
+        """Every pending payload, oldest first, reading each segment file
+        ONCE — the boot-replay path for consumers that rebuild in-memory
+        state from the whole backlog (the fleet store's tier restore),
+        where a peek_at() walk would reopen the segment per record. The
+        entry index is snapshotted under the lock; all file I/O happens
+        outside it. Unreadable/torn entries are skipped, never raised —
+        replay keeps whatever prefix the disk still answers for."""
+        with self._lock:
+            entries = list(self._entries)
+        cur_seg = -1
+        f: IO[bytes] | None = None
+        try:
+            for seg, _idx, off, length in entries:
+                if seg != cur_seg:
+                    if f is not None:
+                        f.close()
+                    f = None
+                    cur_seg = seg
+                    try:
+                        f = open(self._seg_path(seg), "rb")
+                    except OSError:
+                        continue
+                if f is None:
+                    continue
+                try:
+                    f.seek(off)
+                    payload = f.read(length)
+                except OSError:
+                    continue
+                if len(payload) == length:
+                    yield payload
+        finally:
+            if f is not None:
+                f.close()
 
     def trim_to_bytes(self, max_bytes: int) -> int:
         """Drop as many OLDEST records as needed to bring the pending

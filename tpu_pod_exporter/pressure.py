@@ -568,6 +568,42 @@ def build_exporter_governor(
     return gov
 
 
+# ------------------------------------------------------- root-store wiring
+
+
+def register_store_rungs(
+    gov: PressureGovernor, store: Any,
+    store_fn: Callable[[], Any] | None = None,
+) -> None:
+    """Wire a root-side FleetStore (tpu_pod_exporter.store) into the
+    governor: the disk ladder gains the ``store_thin`` rung — the store
+    drops its FINEST tier first (coarse tiers last: they are the cheapest
+    bytes per second of answerable history), with the dropped records
+    counted as ``reason="shed"`` — and the store's in-memory tier bytes
+    register with the memory ladder's component accounting (the shed
+    decision and ``tpu_root_store_memory_bytes`` read the same number).
+    The store's WAL appends also report ENOSPC through the same fault
+    window the persist/egress writers use.
+
+    ``store_fn``: harnesses that SWAP store instances mid-run (the
+    scenario engine's root_restart, the retention demo's kill/replay)
+    pass a getter so the rungs and accounting follow the live instance;
+    the swapping caller must re-apply ``set_pressure_hook`` (and any held
+    thin state) to each fresh instance — hooks live on the instance. The
+    disk paths are registered once: they derive from the tier config,
+    which an instance swap on the same dir preserves."""
+    get = store_fn if store_fn is not None else (lambda: store)
+    for path in store.disk_paths():
+        gov.add_disk_path(path)
+    gov.add_disk_rung(
+        "store_thin",
+        lambda: get().set_thin(True),
+        lambda: get().set_thin(False),
+    )
+    gov.register_memory_component("store", lambda: int(get().memory_bytes()))
+    store.set_pressure_hook(gov.report_io_error)
+
+
 # --------------------------------------------------------------------- demo
 
 

@@ -31,6 +31,9 @@ Grammar (``--timeline``; events separated by ``;`` or top-level ``,``)::
                                       hammer the serving tier
     clock_step(S)                     one NTP-shaped wall-clock step of S
                                       seconds (signed; instantaneous)
+    root_restart()                    SIGKILL-shaped root death for the
+                                      +duration window, then a fresh root
+                                      (and fleet store) on the same dirs
 
 ``@round`` is the event's first engine round (0-based); ``+duration`` is
 the window length in rounds (default 1). Examples::
@@ -71,6 +74,11 @@ EVENT_KINDS: tuple[str, ...] = (
     # interpreted by the engine through the pressure governor and the
     # chaos host-level injectors (ClockStepper / ScrapeStorm).
     "disk_full", "mem_pressure", "scrape_storm", "clock_step",
+    # Fleet-store kind (ISSUE 11): SIGKILL-shaped root death for
+    # +duration rounds, then a fresh root (and fleet store, when one is
+    # attached) rebuilt on the same state dirs — the store-continuity
+    # drill's boundary.
+    "root_restart",
 )
 
 TIERS: tuple[str, ...] = ("node", "leaf", "root", "recv")
@@ -289,7 +297,9 @@ def parse_event(raw: str) -> ScenarioEvent:
                             "+duration")
         return ev
 
-    # recv_outage / disk_full / mem_pressure
+    # recv_outage / disk_full / mem_pressure / root_restart
+    # (root_restart's +duration is the DOWNTIME window in rounds: the
+    # root is dead for the window, restarted when it closes.)
     if args:
         raise _err(raw, f"{kind} takes no arguments (got {args})")
     return ev
@@ -332,6 +342,17 @@ class Scenario:
     # Tunables the engine reads:
     settle_rounds: int = 3
     uses_egress: bool = True
+    # Attach a FleetStore to the root (tpu_pod_exporter.store): the
+    # store-continuity drill's subject. The engine's --store off flag
+    # is this drill's negative control — the continuity invariant still
+    # runs and must FAIL on the gap.
+    uses_store: bool = False
+    # Minimum wall time per engine round. The store drill NEEDS paced
+    # rounds: a bucket only becomes durable when the NEXT one opens, and
+    # a SIGKILL legitimately loses the open bucket — back-to-back
+    # subsecond rounds would cram every pre-kill sample into one open
+    # bucket and make the (correct) continuity invariant flaky.
+    round_pause_s: float = 0.0
 
     def events(self) -> list[ScenarioEvent]:
         return parse_scenario(self.timeline)
@@ -450,6 +471,27 @@ SCENARIOS: dict[str, Scenario] = {
                 "rejects are attributable from the reject counters."
             ),
             settle_rounds=3,
+        ),
+        Scenario(
+            name="store_continuity",
+            timeline="root_restart()@4+2; churn_storm(8)@7+1",
+            description=(
+                "Fleet TSDB-lite continuity: the root dies SIGKILL-shaped "
+                "for 2 rounds mid-retention, restarts on the same store "
+                "dir (tier replay), and a reshard churn wave lands right "
+                "after. A query over the boundary must be gap-free — the "
+                "store fills the dead window from replayed buckets — with "
+                "per-row source attribution honest (store rows say store, "
+                "live rows say live) and recording-rule series answerable "
+                "from the store alone. With --store off the SAME check "
+                "must fail on the gap (the negative control CI asserts)."
+            ),
+            settle_rounds=4,
+            uses_egress=False,
+            uses_store=True,
+            # One finest store bucket (engine tiers: 0.25 s) must
+            # finalize per pre-kill round — see round_pause_s above.
+            round_pause_s=0.35,
         ),
         Scenario(
             name="recv_outage",

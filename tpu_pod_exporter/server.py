@@ -656,6 +656,17 @@ class _Handler(BaseHTTPRequestHandler):
         if self.fleet is not None:
             self._serve_fleet_api(path, param, match)
             return
+        if param("source"):
+            # The node tier has no store: a ?source= knob that silently
+            # does nothing would let an operator trust an answer that is
+            # not what they asked for (same rule as the store-less
+            # aggregator below).
+            self._serve_json(400, {
+                "status": "error",
+                "error": "source= requires a store-backed root "
+                         "(no fleet store attached on this tier)",
+            })
+            return
         h = self.history
         if h is None:
             self._serve_json(404, {
@@ -665,7 +676,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if path == "/api/v1/series":
-                self._serve_json(200, {"status": "ok", "data": h.series_list()})
+                self._serve_json(200, {"status": "ok", "source": "live",
+                                       "data": h.series_list()})
                 return
             if path == "/api/v1/query_range":
                 metric, start, end, step, agg = self._parse_range_params(
@@ -681,6 +693,11 @@ class _Handler(BaseHTTPRequestHandler):
                     return
                 self._serve_json(200, {
                     "status": "ok",
+                    # Shared envelope contract across tiers: node-local
+                    # answers are "live" by definition (the root's
+                    # store-backed plane answers live|store|merged under
+                    # the same key) — shapes must not drift between tiers.
+                    "source": "live",
                     "data": {"resultType": "matrix", "result": result},
                 })
                 return
@@ -694,7 +711,7 @@ class _Handler(BaseHTTPRequestHandler):
                                  f"matching {match!r} in window",
                     })
                     return
-                self._serve_json(200, {"status": "ok",
+                self._serve_json(200, {"status": "ok", "source": "live",
                                        "data": {"result": result}})
                 return
         except ValueError as e:
@@ -708,20 +725,37 @@ class _Handler(BaseHTTPRequestHandler):
         plus per-target status — and a dead target is partial=true, never
         a non-200 round failure."""
         fleet = self.fleet
+        # ?source=live|store|merged is meaningful only on a store-backed
+        # plane (the root with --store-dir). Asking a store-less tier for
+        # it must be an actionable 400, never a silently-ignored knob —
+        # an operator reading "source":"live" back from a query they sent
+        # ?source=store to would trust data that is not what they asked.
+        source = param("source")
+        kwargs: dict = {}
+        if getattr(fleet, "handles_source", False):
+            if source:
+                kwargs["source"] = source
+        elif source:
+            self._serve_json(400, {
+                "status": "error",
+                "error": "source= requires a store-backed root "
+                         "(no fleet store attached on this tier)",
+            })
+            return
         try:
             if path == "/api/v1/series":
-                self._serve_json(200, fleet.series())
+                self._serve_json(200, fleet.series(**kwargs))
                 return
             if path == "/api/v1/query_range":
                 metric, start, end, step, agg = self._parse_range_params(
                     param)
                 self._serve_json(200, fleet.query_range(
-                    metric, match, start, end, step, agg=agg))
+                    metric, match, start, end, step, agg=agg, **kwargs))
                 return
             if path == "/api/v1/window_stats":
                 metric, window = self._parse_window_params(param)
                 self._serve_json(200, fleet.window_stats(
-                    metric, match, window_s=window))
+                    metric, match, window_s=window, **kwargs))
                 return
         except ValueError as e:
             self._serve_json(400, {"status": "error", "error": str(e)})

@@ -71,7 +71,12 @@ from tpu_pod_exporter.aggregate import (
     emit_rollups,
     read_targets_file,
 )
-from tpu_pod_exporter.fleet import default_api_fetch, target_query_url
+from tpu_pod_exporter.fleet import (
+    data_shape as fleet_data_shape,
+    default_api_fetch,
+    rows_of as fleet_rows_of,
+    target_query_url,
+)
 from tpu_pod_exporter.metrics import (
     CounterStore,
     HistogramStore,
@@ -646,6 +651,7 @@ class RootAggregator:
         shard_map_store: Any = None,  # persist.ShardMapFile | None
         breaker_store: Any = None,  # persist.BreakerStateFile | None
         stale_serve_s: float = 0.0,
+        fleet_store: Any = None,  # store.FleetStore | None
     ) -> None:
         if not topology:
             raise ValueError("root needs at least one shard of leaves")
@@ -702,6 +708,11 @@ class RootAggregator:
         # pre-hardening behavior the both-leaves-dead tests pin for the
         # disabled case).
         self._stale_serve_s = stale_serve_s
+        # Fleet TSDB-lite (tpu_pod_exporter.store): after each round's
+        # publish, the merged rollups + per-target series append into the
+        # store's downsample tiers, and the tpu_root_store_* surface rides
+        # this root's exposition. Owned here for lifecycle (close()).
+        self._fleet_store = fleet_store
         self._last_views: dict[str, tuple[LeafView, float]] = {}
         # Last round's health summary, read by ready_detail() from HTTP
         # threads (swapped atomically as a tuple).
@@ -913,6 +924,18 @@ class RootAggregator:
         # AFTER publish, same discipline as the leaf tier: disk latency
         # during a leaf incident must not read as round time.
         self._leaf_set.maybe_save_breakers()
+        if self._fleet_store is not None:
+            # Also after publish: the store folds the just-published
+            # snapshot (tracked rollups + per-target series + recording
+            # rules) into its tiers; its WAL write rides the round thread
+            # but never the published round duration, and a store failure
+            # can never fail a round.
+            try:
+                self._fleet_store.append_snapshot(
+                    self._store.current(), now_wall=now_wall)
+            except Exception as e:  # noqa: BLE001 — history must not break merging
+                self._rlog.warning("fleet_store",
+                                   "fleet store append failed: %s", e)
 
     def _publish(
         self,
@@ -1008,6 +1031,11 @@ class RootAggregator:
                       float(self._loop_overruns_fn()))
             except Exception:  # noqa: BLE001 — accounting must never fail a round
                 pass
+        if self._fleet_store is not None:
+            try:
+                self._fleet_store.emit(b)
+            except Exception:  # noqa: BLE001 — store surface must not fail publish
+                pass
         cpu_s = utils.process_cpu_seconds()
         if cpu_s is not None:
             b.add(schema.TPU_AGG_CPU_SECONDS_TOTAL, cpu_s)
@@ -1079,6 +1107,8 @@ class RootAggregator:
             "topology": {s: list(ls) for s, ls in self.topology.items()},
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
+            "store": (self._fleet_store.stats()
+                      if self._fleet_store is not None else None),
             "stale_serve_s": self._stale_serve_s,
             "stale_view_bytes": self.stale_view_bytes(),
             "stale_served_leaves": self._health[2],
@@ -1102,6 +1132,11 @@ class RootAggregator:
     def close(self) -> None:
         self._leaf_set.maybe_save_breakers(force=True)
         self._pool.shutdown(wait=False)
+        if self._fleet_store is not None:
+            try:
+                self._fleet_store.close()
+            except Exception:  # noqa: BLE001 — draining must finish
+                pass
 
 
 # ---------------------------------------------------------- two-level queries
@@ -1217,23 +1252,10 @@ class RootQueryPlane:
             return leaf, "error", None, str(e), time.monotonic() - t0
         return leaf, "ok", doc, "", time.monotonic() - t0
 
-    @staticmethod
-    def _rows_of(route: str, env: Mapping[str, Any]) -> list:
-        data = env.get("data")
-        if route == "series":
-            return data if isinstance(data, list) else []
-        if isinstance(data, dict):
-            rows = data.get("result")
-            return rows if isinstance(rows, list) else []
-        return []
-
-    @staticmethod
-    def _data_shape(route: str, merged: list[dict]) -> Any:
-        if route == "series":
-            return merged
-        if route == "query_range":
-            return {"resultType": "matrix", "result": merged}
-        return {"result": merged}
+    # The ONE shape implementation (fleet.data_shape/rows_of) — tiers
+    # must not drift.
+    _rows_of = staticmethod(fleet_rows_of)
+    _data_shape = staticmethod(fleet_data_shape)
 
     def _query(self, route: str, path: str,
                params: Mapping[str, str]) -> dict:
@@ -1361,6 +1383,10 @@ class RootQueryPlane:
             "status": "ok",
             "partial": partial,
             "route": route,
+            # Two-level fan-out answers are "live"; the store-backed
+            # wrapper (store.StoreQueryPlane) upgrades this to
+            # live|store|merged — one envelope contract across tiers.
+            "source": "live",
             "data": self._data_shape(route, merged),
             "targets": targets,
             "leaves": leaf_states,
@@ -1449,6 +1475,33 @@ def main(argv: list[str] | None = None) -> int:
                         "degrades the fleet view to stale-but-labeled "
                         "instead of emptying it; 0 disables, try 3x "
                         "--interval-s")
+    p.add_argument("--store-dir", default="",
+                   help="[root] fleet TSDB-lite: persist each round's "
+                        "merged rollups + per-target series into disk-"
+                        "backed downsample tiers here, so fleet history "
+                        "spans DAYS and survives root restarts, leaf "
+                        "death and resharding; /api/v1 answers gain "
+                        "source=live|store|merged (store fills what the "
+                        "live fan-out cannot reach; ?source=store "
+                        "answers from the store alone). Empty disables")
+    p.add_argument("--store-tiers", default="",
+                   help="[root] store downsample tiers, step:capacity "
+                        "pairs finest first (default 60:240,600:1008 = "
+                        "4 h at 1 min + exactly 7 d at 10 min)")
+    p.add_argument("--store-rules", default="",
+                   help="[root] recording-rule file: one "
+                        "'name = agg(metric{label=\"v\"}) by (labels)' "
+                        "per line, evaluated each round into its own "
+                        "stored series so dashboards hit precomputed "
+                        "rollups instead of fan-outs; malformed rules "
+                        "fail startup loudly")
+    p.add_argument("--store-max-disk-mb", type=float, default=0.0,
+                   help="[root] disk budget over the store dir, enforced "
+                        "by the pressure governor: past it the disk "
+                        "ladder sheds store_thin (finest tier dropped "
+                        "first, counted as reason=\"shed\"; coarse tiers "
+                        "— the days-long window — shed last). 0 = no "
+                        "budget (retention trim alone bounds disk)")
     ns = p.parse_args(argv)
     utils.setup_logging(ns.log_level, ns.log_format)
     if ns.role == "leaf":
@@ -1574,6 +1627,49 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         breaker_store = BreakerStateFile(
             os.path.join(ns.state_dir, "root-leaf-breakers.json"))
     store = SnapshotStore()
+    # Fleet TSDB-lite: open (and replay) the store BEFORE the root so the
+    # first round already appends; malformed rules and an uncreatable dir
+    # are startup errors, never silent no-ops.
+    fleet_store: Any = None
+    governor: Any = None
+    if not ns.store_dir and (ns.store_max_disk_mb > 0 or ns.store_tiers
+                             or ns.store_rules):
+        # A budget/tier/rule flag without the store itself would silently
+        # enforce nothing — the operator believes history is governed.
+        p.error("--store-max-disk-mb/--store-tiers/--store-rules require "
+                "--store-dir (no fleet store is configured)")
+    if ns.store_dir:
+        from tpu_pod_exporter.store import (
+            DEFAULT_STORE_TIERS,
+            FleetStore,
+            load_rules_file,
+        )
+
+        try:
+            rules = (load_rules_file(ns.store_rules)
+                     if ns.store_rules else ())
+            fleet_store = FleetStore(
+                ns.store_dir, tiers=ns.store_tiers or DEFAULT_STORE_TIERS,
+                rules=rules)
+            info = fleet_store.open()
+        except (OSError, ValueError) as e:
+            p.error(f"--store-dir/--store-rules: {e}")
+        log.info("fleet store %s: %d tier(s), %d rule(s), replayed %d "
+                 "buckets across %d series",
+                 ns.store_dir, len(fleet_store.tier_spec),
+                 len(fleet_store.rules), info["buckets"], info["series"])
+        if ns.store_max_disk_mb > 0:
+            from tpu_pod_exporter.pressure import (
+                PressureGovernor,
+                register_store_rungs,
+            )
+
+            budget = int(ns.store_max_disk_mb * (1 << 20))
+            governor = PressureGovernor(disk_budget_bytes=budget,
+                                        sidecar_dir=ns.store_dir)
+            register_store_rungs(governor, fleet_store)
+            fleet_store.disk_budget_bytes = budget
+            governor.start()
     root = RootAggregator(
         topology, store, timeout_s=ns.timeout_s,
         loop_overruns_fn=lambda: loop.overruns,
@@ -1582,11 +1678,18 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         shard_map_store=shard_map_store,
         breaker_store=breaker_store,
         stale_serve_s=ns.stale_serve_s,
+        fleet_store=fleet_store,
     )
-    plane = None
+    plane: Any = None
     if ns.fleet_query == "on":
         plane = RootQueryPlane(topology, timeout_s=ns.timeout_s + 0.5,
                                leaf_breakers=root._breakers)
+    if fleet_store is not None:
+        from tpu_pod_exporter.store import StoreQueryPlane
+
+        # Source-aware front: live fan-out + store fills (store-only when
+        # --fleet-query off). Serves through the same server hook.
+        plane = StoreQueryPlane(plane, fleet_store)
     loop = CollectorLoop(root, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
@@ -1598,7 +1701,7 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
     log.info("root merging %d shard(s) / %d leaf(s) on :%d every %.1fs",
              len(topology), sum(len(v) for v in topology.values()),
              server.port, ns.interval_s)
-    closers = [c for c in (plane, root) if c is not None]
+    closers = [c for c in (plane, governor, root) if c is not None]
     return _serve_until_signal(loop, server, closers)
 
 

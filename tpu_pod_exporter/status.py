@@ -59,12 +59,19 @@ def main(argv=None) -> int:
                           "aggregation tree topology (leaves, HA pairs, "
                           "per-shard target counts, quarantines, freshness "
                           "winner) from the root's /metrics")
+    pre.add_argument("--store-dir", default="",
+                     help="with --tree on the root host: read the fleet "
+                          "store's store-status.json sidecar from this "
+                          "dir and append a store: footer (retention "
+                          "span, disk vs budget, rules, last-append age)")
     ns, rest = pre.parse_known_args(argv)
     if ns.tree:
         try:
             if ns.watch <= 0:
-                return _run_tree(ns.tree, as_json=ns.json)
-            return _watch_tree(ns.tree, ns.watch, as_json=ns.json)
+                return _run_tree(ns.tree, as_json=ns.json,
+                                 store_dir=ns.store_dir)
+            return _watch_tree(ns.tree, ns.watch, as_json=ns.json,
+                               store_dir=ns.store_dir)
         except KeyboardInterrupt:
             return 0
     if ns.fleet:
@@ -347,7 +354,50 @@ def render_tree(doc: dict) -> str:
         footer += "\n  leaves down: " + ", ".join(down)
     out.append("")
     out.append(footer)
+    store = doc.get("store")
+    if store is not None:
+        out.append(store_line(store))
+    elif doc.get("store_error"):
+        # A typo'd --store-dir must look different from "no store
+        # configured" — the forensics playbook starts here.
+        out.append(f"store: {doc['store_error']}")
     return "\n".join(out)
+
+
+def store_line(doc: dict) -> str:
+    """``store:`` footer from the fleet store's on-disk sidecar
+    (tpu_pod_exporter.store.store_status_summary): retention span, disk
+    bytes vs budget, rules evaluated, last-append age — the four numbers
+    the RUNBOOK's forensics playbook reads first."""
+    span = doc.get("span_s") or 0.0
+    span_txt = (f"{span / 86400.0:.1f}d" if span >= 86400.0
+                else f"{span / 3600.0:.1f}h" if span >= 3600.0
+                else f"{span:.0f}s")
+    parts = [f"store: span {span_txt}"]
+    disk = doc.get("disk_bytes")
+    budget = doc.get("disk_budget_bytes") or 0
+    if disk is not None:
+        d = fmt_bytes(float(disk))
+        if budget:
+            over = " OVER" if disk > budget else ""
+            parts.append(f"disk {d}/{fmt_bytes(float(budget))}{over}")
+        else:
+            parts.append(f"disk {d} (no budget)")
+    if doc.get("thinned"):
+        parts.append("THINNED (finest tier shed)")
+    rules = doc.get("rules") or 0
+    parts.append(f"rules {rules} "
+                 f"(evaluated {doc.get('rules_evaluated_total', 0):g})")
+    last = doc.get("last_append_wall")
+    if last:
+        parts.append(f"last append {max(time.time() - last, 0.0):.1f}s ago")
+    failures = doc.get("append_failures") or 0
+    if failures:
+        parts.append(f"APPEND FAILURES {failures:g}")
+    series = doc.get("series")
+    if series is not None:
+        parts.append(f"{series:g} series")
+    return " · ".join(parts)
 
 
 def render_tree_screen(addr: str, doc: dict | None, error=None,
@@ -372,9 +422,29 @@ def render_tree_screen(addr: str, doc: dict | None, error=None,
     return "\n".join(out)
 
 
-def _watch_tree(addr: str, interval_s: float, as_json=False) -> int:
+def _attach_store(doc: dict, store_dir: str) -> dict:
+    """Attach the fleet store's sidecar summary under ``doc["store"]``
+    (rendered by render_tree and carried in the JSON stream). Absent or
+    unreadable sidecars attach nothing — the tree view stays usable on
+    roots without a store."""
+    if store_dir:
+        from tpu_pod_exporter.store import store_status_summary
+
+        summary = store_status_summary(store_dir)
+        if summary is not None:
+            doc["store"] = summary
+        else:
+            doc["store_error"] = (
+                f"no store-status.json under {store_dir}")
+    return doc
+
+
+def _watch_tree(addr: str, interval_s: float, as_json=False,
+                store_dir: str = "") -> int:
     """``--tree --watch``: re-render until interrupted, surviving root
-    outages with a last-known-state footer instead of exiting."""
+    outages with a last-known-state footer instead of exiting. The store
+    sidecar is re-read every interval — a thinning or append-failing
+    store shows up mid-watch."""
     import json as _json
 
     last_doc: dict | None = None
@@ -382,7 +452,7 @@ def _watch_tree(addr: str, interval_s: float, as_json=False) -> int:
     while True:
         error = None
         try:
-            doc = fetch_tree(addr)
+            doc = _attach_store(fetch_tree(addr), store_dir)
             last_doc = doc
             last_ok = time.monotonic()
         except Exception as e:  # noqa: BLE001 — watch mode outlives outages
@@ -411,11 +481,11 @@ def _watch_tree(addr: str, interval_s: float, as_json=False) -> int:
         time.sleep(interval_s)
 
 
-def _run_tree(addr: str, as_json=False) -> int:
+def _run_tree(addr: str, as_json=False, store_dir: str = "") -> int:
     import json as _json
 
     try:
-        doc = fetch_tree(addr)
+        doc = _attach_store(fetch_tree(addr), store_dir)
     except Exception as e:  # noqa: BLE001 — a down root is the answer
         print(f"tree query against {addr} failed: {e}", file=sys.stderr)
         return 1
