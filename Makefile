@@ -105,10 +105,23 @@ fleet-query-demo:
 # Rollups are asserted equal to a flat single-aggregator oracle over the
 # same scrape set at every checkpoint. CI runs a reduced-target smoke
 # (see .github/workflows/ci.yml) and uploads the state dir on failure.
+# Mixed fleet by default: 2 of the farm's 8 slices are GPU node pools
+# (gpu_* node surface), so both device families ride one tree and the
+# per-family fleet rollups are asserted against a per-family oracle +
+# arithmetic ground truth. --gpu-slices 0 restores a homogeneous farm.
 shard-demo:
 	python -m tpu_pod_exporter.loadgen.fleet --mode shard --targets 1000 \
 		--shards 8 --chips 2 --churn 32 --round-budget-s 15 \
-		--state-root shard-demo-state
+		--gpu-slices 2 --state-root shard-demo-state
+
+# GPU path, deterministically, without a driver: replay the committed
+# NVML-shaped fixture (tests/fixtures/gpu-recorded.jsonl — 2 simulated
+# A100s, per-process tables, one injected NVML_ERROR_TIMEOUT) through the
+# real collector and assert the gpu_* node surface comes out, per-pod GPU
+# memory joins, and the injected fault degrades that chip only.
+gpu-demo:
+	python -m tpu_pod_exporter.backend.nvml --demo \
+		--recording tests/fixtures/gpu-recorded.jsonl
 
 # Remote-write egress acceptance (deploy/RUNBOOK.md "Egress backlog
 # playbook"): a seeded chaos receiver (hang/5xx/429/mid-body truncation)
@@ -127,10 +140,12 @@ egress-demo:
 egress-drain-check:
 	python -m tpu_pod_exporter.egress --drain-check --outage-s 180 --budget-s 20
 
-# Fleet scenario engine (deploy/RUNBOOK.md "Partition playbook"): runs the
-# 7 named chaos timelines (symmetric/asymmetric/flapping partitions, slice
-# preemption, restart wave + hotspot, churn storm, receiver outage —
-# tpu_pod_exporter/scenario.py DSL) against the FULL simulated stack
+# Fleet scenario engine (deploy/RUNBOOK.md "Partition playbook"): runs
+# every named chaos timeline (symmetric/asymmetric/flapping partitions,
+# slice preemption, restart wave + hotspot, churn storm, receiver outage,
+# the resource-pressure drills, store continuity, and the mixed_wedge GPU
+# parity drill — tpu_pod_exporter/scenario.py DSL) against the FULL
+# simulated stack
 # (synthetic node farm → real HA leaf tier → real root → remote-write
 # egress into a ledgered chaos receiver), with invariants asserted at
 # every tick: zero acked-sample loss, bounded per-tier staleness, root

@@ -127,7 +127,7 @@ class TestSliceAggregator:
     def test_slice_rollups(self):
         self.agg().poll_once()
         snap = self.store.current()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         assert snap.value("tpu_slice_chip_count", key) == 8.0
         assert snap.value("tpu_slice_hosts_reporting", key) == 2.0
         assert snap.value("tpu_slice_hbm_used_bytes", key) == 8 * GIB
@@ -154,7 +154,7 @@ class TestSliceAggregator:
         a = SliceAggregator(tuple(self.pages), self.store, fetch=fetch)
         a.poll_once()
         snap = self.store.current()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 0.0
         assert snap.value("tpu_aggregator_target_up", {"target": "h0:8000"}) == 1.0
         assert snap.value("tpu_slice_chip_count", key) == 4.0
@@ -182,7 +182,7 @@ class TestSliceAggregator:
         # h1 contributed nothing despite its valid prefix.
         assert snap.value(
             "tpu_slice_chip_count",
-            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+            {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"},
         ) == 4.0
 
     def test_garbage_outside_consumed_families_is_tolerated(self):
@@ -198,7 +198,7 @@ class TestSliceAggregator:
         assert snap.value("tpu_aggregator_target_up", {"target": "h1:8000"}) == 1.0
         assert snap.value(
             "tpu_slice_chip_count",
-            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+            {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"},
         ) == 8.0
 
     def test_missing_host_label_not_counted_as_a_host(self):
@@ -218,7 +218,7 @@ class TestSliceAggregator:
             tuple(pages), store, fetch=StaticFetch(pages)
         ).poll_once()
         snap = store.current()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
         assert snap.value("tpu_slice_chip_count", key) == 5.0
 
@@ -234,7 +234,7 @@ class TestSliceAggregator:
         assert parse_families(snap.encode().decode()).get("tpu_workload_chip_count") in (None, [])
         # Chip-level slice rollups still exist (empty slice/accelerator labels).
         assert snap.value(
-            "tpu_slice_chip_count", {"slice_name": "", "accelerator": ""}
+            "tpu_slice_chip_count", {"slice_name": "", "accelerator": "", "family": "tpu"}
         ) == 2.0
 
     def test_empty_targets_rejected(self):
@@ -274,7 +274,8 @@ class TestAggregatorOverHTTP:
             fams = parse_families(body)
             (chip_count,) = fams["tpu_slice_chip_count"]
             assert chip_count.labels == {
-                "slice_name": "s-e2e", "accelerator": "v5e-16"
+                "slice_name": "s-e2e", "accelerator": "v5e-16",
+                "family": "tpu",
             }
             assert chip_count.value == 4.0
             (up,) = fams["tpu_aggregator_target_up"]
@@ -311,8 +312,8 @@ class TestMultiSlice:
             tuple(pages), agg_store, fetch=StaticFetch(pages)
         ).poll_once()
         snap = agg_store.current()
-        a = {"slice_name": "slice-a", "accelerator": "v5p-64"}
-        b_ = {"slice_name": "slice-b", "accelerator": "v5p-64"}
+        a = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
+        b_ = {"slice_name": "slice-b", "accelerator": "v5p-64", "family": "tpu"}
         assert snap.value("tpu_slice_chip_count", a) == 8.0
         assert snap.value("tpu_slice_chip_count", b_) == 4.0
         assert snap.value("tpu_slice_hosts_reporting", a) == 2.0
@@ -452,7 +453,7 @@ class TestParseNameFilter:
         from tpu_pod_exporter import aggregate as agg_mod
 
         src = inspect.getsource(SliceAggregator._consume)
-        referenced = set(re.findall(r'"(tpu_[a-z_]+)"', src))
+        referenced = set(re.findall(r'"((?:tpu|gpu)_[a-z_]+)"', src))
         assert referenced == set(agg_mod.CONSUMED_NAMES)
 
 
@@ -498,7 +499,7 @@ class TestUnreadableHbmHostsStillCounted:
         agg.poll_once()
         agg.close()
         snap = agg_store.current()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         assert snap.value("tpu_slice_chip_count", key) == 4.0
         assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
         # ...but the slice HBM rollups stay ABSENT (not fake zeros): no
@@ -513,7 +514,7 @@ class TestAggregateHonesty:
     tier — workload HBM, slice percent on mismatched coverage — and mixed
     fleets undercounting presence must be loud."""
 
-    KEY = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+    KEY = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
 
     def _aggregate(self, text):
         store = SnapshotStore()
@@ -706,7 +707,7 @@ class TestAggregatorDebugVars:
         pages = {"h0:8000": small}
         store = SnapshotStore()
         agg = SliceAggregator(("h0:8000",), store, fetch=StaticFetch(pages))
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         try:
             agg.poll_once()
             assert store.current().value("tpu_slice_chip_count", key) == 2.0
@@ -776,7 +777,7 @@ class TestRealHardwareExposition:
         agg.poll_once()
         agg.close()
         snap = store.current()
-        key = {"slice_name": "", "accelerator": "v5e"}
+        key = {"slice_name": "", "accelerator": "v5e", "family": "tpu"}
         assert snap.value("tpu_slice_chip_count", key) == 1.0
         assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
         # No HBM samples on the wire -> no slice HBM rollups fabricated.
@@ -921,7 +922,7 @@ class TestMultisliceRollups:
         assert snap.value("tpu_multislice_ici_bytes_per_second", g) > 0
         assert snap.value("tpu_multislice_dcn_bytes_per_second", g) > 0
         # The per-slice DCN rollup exists alongside the group one.
-        skey = {"slice_name": "s0", "accelerator": "v5p-128"}
+        skey = {"slice_name": "s0", "accelerator": "v5p-128", "family": "tpu"}
         assert snap.value("tpu_slice_dcn_bytes_per_second", skey) > 0
 
     def test_missing_slice_shows_in_reporting_vs_expected(self):
@@ -964,7 +965,7 @@ class TestMultisliceRollups:
         snap = self._aggregate(pages)
         assert snap.value(
             "tpu_slice_dcn_bytes_per_second",
-            {"slice_name": "slice-a", "accelerator": "v5p-64"},
+            {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"},
         ) is None
 
 
@@ -1021,7 +1022,7 @@ class TestAggregatorCli:
                 except OSError:
                     pass
                 time.sleep(0.2)
-            assert 'tpu_slice_chip_count{slice_name="sa",accelerator="v4-8"} 2' in body
+            assert 'tpu_slice_chip_count{slice_name="sa",accelerator="v4-8",family="tpu"} 2' in body
             assert "tpu_aggregator_target_up" in body
             proc.send_signal(signal.SIGTERM)
             assert proc.wait(timeout=15) == 0  # clean drain
@@ -1388,7 +1389,7 @@ class TestRoundRecordReplay:
         store = SnapshotStore()
         agg = SliceAggregator(fetch.targets, store, fetch=fetch)
         try:
-            key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+            key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
             agg.poll_once()
             snap = store.current()
             assert snap.value("tpu_slice_hosts_reporting", key) == 2.0
@@ -1455,7 +1456,7 @@ class TestRoundRecordReplay:
         )
         agg2.poll_once()
         agg2.close()
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         for name in ("tpu_slice_chip_count", "tpu_slice_hbm_used_bytes",
                      "tpu_slice_hosts_reporting"):
             assert (

@@ -33,7 +33,7 @@ class TestAggregatorAtSliceScale:
             agg.poll_once()
             cold = time.perf_counter() - t0
             snap = store.current()
-            key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+            key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
             assert snap.value("tpu_slice_chip_count", key) == 64 * 256.0
             assert snap.value("tpu_slice_hosts_reporting", key) == 64.0
             assert cold < 10.0, f"cold aggregator round took {cold:.2f}s at 64x256"

@@ -1,0 +1,516 @@
+"""NVML-shaped GPU backend + mixed-fleet plumbing (ISSUE 12).
+
+Covers the second device family end to end: the simulated driver's NVML
+call surface and error codes, the backend's degrade-not-die mapping
+(total vs per-device failures — inverting main.go:119-137), the
+collector's gpu_* twins and the per-pod memory join, record/replay of GPU
+samples (committed fixture), chaos NVML error shapes, and the
+family-keyed rollups up the aggregation tree.
+"""
+
+import json
+
+import pytest
+
+from tpu_pod_exporter.attribution import DeviceAllocation
+from tpu_pod_exporter.attribution.fake import FakeAttribution
+from tpu_pod_exporter.backend import BackendError, ChipInfo
+from tpu_pod_exporter.backend.fake import FakeBackend
+from tpu_pod_exporter.backend.nvml import (
+    GpuScript,
+    NvmlBackend,
+    NvmlError,
+    SimulatedNvmlDriver,
+    normalize_nvml_code,
+    run_gpu_demo,
+    sim_driver_from_spec,
+)
+from tpu_pod_exporter.collector import Collector
+from tpu_pod_exporter.metrics import SnapshotStore
+from tpu_pod_exporter.metrics.parse import parse_families
+
+GIB = 1024**3
+
+FIXTURE = "tests/fixtures/gpu-recorded.jsonl"
+
+
+def collect_once(backend, attribution=None, polls=1):
+    store = SnapshotStore()
+    c = Collector(backend, attribution or FakeAttribution(), store)
+    for _ in range(polls):
+        c.poll_once()
+    c.close()
+    return store.current(), c
+
+
+def families_of(snap):
+    return parse_families(snap.encode().decode())
+
+
+# ---------------------------------------------------------------- the driver
+
+
+class TestSimulatedDriver:
+    def test_call_surface_and_step(self):
+        drv = SimulatedNvmlDriver([
+            GpuScript(mem_used_bytes=lambda s: float(s), mem_total_bytes=10.0),
+        ])
+        drv.nvmlInit()
+        assert drv.nvmlDeviceGetCount() == 1  # step -> 0
+        h = drv.nvmlDeviceGetHandleByIndex(0)
+        assert drv.nvmlDeviceGetMemoryInfo(h)["used"] == 0.0
+        assert drv.nvmlDeviceGetCount() == 1  # step -> 1
+        assert drv.nvmlDeviceGetMemoryInfo(h)["used"] == 1.0
+        assert drv.nvmlDeviceGetUUID(h) == "GPU-sim-0"
+        drv.nvmlShutdown()
+        assert drv.shutdown_calls == 1
+
+    def test_uninitialized_is_an_nvml_error(self):
+        drv = SimulatedNvmlDriver(1)
+        with pytest.raises(Exception) as ei:
+            drv.nvmlDeviceGetCount()
+        assert getattr(ei.value, "value", None) == 1  # UNINITIALIZED
+
+    def test_injected_fault_fifo(self):
+        drv = SimulatedNvmlDriver(1)
+        drv.nvmlInit()
+        drv.inject("DeviceGetMemoryInfo", "gpu_is_lost", times=2)
+        for _ in range(2):
+            with pytest.raises(Exception) as ei:
+                drv.nvmlDeviceGetMemoryInfo(0)
+            assert getattr(ei.value, "value", None) == 15
+        assert drv.nvmlDeviceGetMemoryInfo(0)["total"] > 0
+
+    def test_code_normalization(self):
+        assert normalize_nvml_code("gpu_is_lost") == (
+            "NVML_ERROR_GPU_IS_LOST", 15)
+        assert normalize_nvml_code("NVML_ERROR_TIMEOUT") == (
+            "NVML_ERROR_TIMEOUT", 10)
+        assert normalize_nvml_code(999) == ("NVML_ERROR_UNKNOWN", 999)
+        with pytest.raises(ValueError):
+            normalize_nvml_code("not_a_code")
+
+    def test_spec_parsing(self):
+        drv = sim_driver_from_spec({
+            "gpus": [{"mem_total": 10, "mem_used": 4, "utilization": 50,
+                      "processes": [[1, 2.0, "c"]]}],
+            "faults": [{"call": "DeviceGetCount", "code": "timeout"}],
+        })
+        drv.nvmlInit()
+        with pytest.raises(Exception):
+            drv.nvmlDeviceGetCount()
+        assert drv.nvmlDeviceGetCount() == 1
+
+    @pytest.mark.parametrize("doc", (
+        {},
+        {"gpus": []},
+        {"gpus": [1]},
+        {"gpus": [{}], "faults": [{"call": "Init"}]},
+    ))
+    def test_bad_spec_raises(self, doc):
+        with pytest.raises(ValueError):
+            sim_driver_from_spec(doc)
+
+
+# ---------------------------------------------------------------- the backend
+
+
+class TestNvmlBackend:
+    def test_sample_shape(self):
+        drv = SimulatedNvmlDriver([
+            GpuScript(mem_used_bytes=2 * GIB, mem_total_bytes=8 * GIB,
+                      utilization_percent=42.0,
+                      processes=[(100, GIB, "train")]),
+        ])
+        be = NvmlBackend(driver=drv)
+        assert be.family == "gpu"
+        s = be.sample()
+        (chip,) = s.chips
+        assert chip.info.family == "gpu"
+        assert chip.info.device_ids[0] == "GPU-sim-0"
+        assert chip.hbm_used_bytes == 2 * GIB
+        assert chip.tensorcore_duty_cycle_percent == 42.0
+        assert chip.processes[0].pid == 100
+        be.close()
+        assert drv.shutdown_calls == 1
+
+    def test_total_failure_raises_coded_error(self):
+        drv = SimulatedNvmlDriver(1)
+        drv.inject("Init", "driver_not_loaded")
+        be = NvmlBackend(driver=drv)
+        with pytest.raises(NvmlError) as ei:
+            be.sample()
+        assert ei.value.code_name == "NVML_ERROR_DRIVER_NOT_LOADED"
+        assert isinstance(ei.value, BackendError)
+        # Init succeeded on retry: the backend recovers without rebuild.
+        assert be.sample().chips
+
+    def test_per_device_failure_degrades_that_chip_only(self):
+        drv = SimulatedNvmlDriver(2)
+        be = NvmlBackend(driver=drv)
+        drv.inject("DeviceGetMemoryInfo", "gpu_is_lost")
+        s = be.sample()
+        assert len(s.chips) == 2
+        assert s.chips[0].hbm_used_bytes is None  # absent beats fake-zero
+        assert s.chips[1].hbm_used_bytes is not None
+        assert any("GPU_IS_LOST" in e for e in s.partial_errors)
+
+    def test_not_supported_utilization_is_absent_not_an_error(self):
+        drv = SimulatedNvmlDriver([GpuScript(utilization_percent=None)])
+        s = NvmlBackend(driver=drv).sample()
+        assert s.chips[0].tensorcore_duty_cycle_percent is None
+        assert s.partial_errors == ()
+
+    def test_close_then_sample_reinitializes(self):
+        drv = SimulatedNvmlDriver(1)
+        be = NvmlBackend(driver=drv)
+        be.sample()
+        be.close()
+        be.sample()  # the supervisor's reconnect path: Shutdown + Init
+        assert drv.init_calls == 2
+        assert drv.shutdown_calls == 1
+
+
+# --------------------------------------------------------- collector surface
+
+
+class TestGpuCollectorSurface:
+    def make_backend(self):
+        return NvmlBackend(driver=SimulatedNvmlDriver([
+            GpuScript(mem_used_bytes=2 * GIB, mem_total_bytes=8 * GIB,
+                      utilization_percent=30.0,
+                      processes=[(100, GIB, "train"), (101, GIB / 2, "io")]),
+            GpuScript(mem_used_bytes=GIB, mem_total_bytes=8 * GIB),
+        ]))
+
+    def test_gpu_twins_published(self):
+        snap, _ = collect_once(self.make_backend())
+        fams = families_of(snap)
+        assert len(fams["gpu_chip_info"]) == 2
+        assert len(fams["gpu_hbm_used_bytes"]) == 2
+        assert len(fams["gpu_process_memory_used_bytes"]) == 2
+        (up,) = fams["gpu_backend_up"]
+        assert up.value == 1.0
+        # The TPU namespace stays sample-less (declared families only).
+        assert not fams.get("tpu_hbm_used_bytes")
+        assert not fams.get("tpu_chip_info")
+
+    def test_gpu_surface_absent_on_tpu_exporter(self):
+        snap, _ = collect_once(FakeBackend(chips=2))
+        text = snap.encode().decode()
+        assert "gpu_backend_up" not in text
+        assert "gpu_chip_info" not in text
+
+    def test_per_pod_memory_joins_like_tpu(self):
+        attr = FakeAttribution(allocations=[
+            DeviceAllocation(pod="trainer", namespace="ml", container="main",
+                             device_ids=("GPU-sim-0", "GPU-sim-1")),
+        ])
+        snap, _ = collect_once(self.make_backend(), attr)
+        fams = families_of(snap)
+        (count,) = fams["gpu_pod_chip_count"]
+        assert count.labels["pod"] == "trainer"
+        assert count.value == 2.0
+        (mem,) = fams["gpu_pod_memory_used_bytes"]
+        assert mem.value == 3 * GIB
+        assert not fams.get("tpu_pod_chip_count")
+
+    def test_gpu_backend_up_drops_on_wedge(self):
+        drv = SimulatedNvmlDriver(1)
+        be = NvmlBackend(driver=drv)
+        store = SnapshotStore()
+        c = Collector(be, FakeAttribution(), store)
+        c.poll_once()
+        drv.inject("DeviceGetCount", "gpu_is_lost")
+        c.poll_once()
+        fams = families_of(store.current())
+        (up,) = fams["gpu_backend_up"]
+        assert up.value == 0.0
+        (eup,) = fams["tpu_exporter_up"]
+        assert eup.value == 0.0  # identical degradation to a TPU wedge
+        c.close()
+
+    def test_process_rows_carry_pod_attribution(self):
+        attr = FakeAttribution(allocations=[
+            DeviceAllocation(pod="trainer", namespace="ml", container="main",
+                             device_ids=("GPU-sim-0",)),
+        ])
+        snap, _ = collect_once(self.make_backend(), attr)
+        rows = families_of(snap)["gpu_process_memory_used_bytes"]
+        by_pid = {s.labels["pid"]: s for s in rows}
+        assert by_pid["100"].labels["pod"] == "trainer"
+        assert by_pid["100"].labels["comm"] == "train"
+        assert by_pid["100"].value == GIB
+
+    def test_mixed_host_splits_pod_rollups_by_family(self):
+        # A recorded/fake mixed host (one GPU chip, one TPU chip, same
+        # pod) must publish BOTH pod rollups — never a cross-family sum.
+        infos = [ChipInfo(chip_id=0, family="gpu", device_ids=("g0",)),
+                 ChipInfo(chip_id=1, family="tpu", device_ids=("t0",))]
+        be = FakeBackend(chips=infos)
+        attr = FakeAttribution(allocations=[
+            DeviceAllocation(pod="p", namespace="n", container="c",
+                             device_ids=("g0", "t0")),
+        ])
+        snap, _ = collect_once(be, attr)
+        fams = families_of(snap)
+        (g,) = fams["gpu_pod_chip_count"]
+        (t,) = fams["tpu_pod_chip_count"]
+        assert g.value == 1.0 and t.value == 1.0
+
+
+# ------------------------------------------------------------- record/replay
+
+
+class TestGpuRecorded:
+    def test_fixture_replays_family_and_processes(self):
+        from tpu_pod_exporter.backend.recorded import RecordedBackend
+
+        rb = RecordedBackend(FIXTURE)
+        assert rb.family == "gpu"
+        s = rb.sample()
+        assert all(c.info.family == "gpu" for c in s.chips)
+        assert s.chips[0].processes[0].comm == "train"
+        # The injected NVML fault replays as the partial error it was.
+        assert any("NVML_ERROR_TIMEOUT" in e for e in s.partial_errors)
+
+    def test_round_trip_preserves_gpu_fields(self):
+        from tpu_pod_exporter.backend.recorded import (
+            sample_from_dict,
+            sample_to_dict,
+        )
+
+        drv = SimulatedNvmlDriver([
+            GpuScript(mem_used_bytes=GIB, processes=[(7, 8.0, "x")]),
+        ])
+        s = NvmlBackend(driver=drv).sample()
+        doc = json.loads(json.dumps(sample_to_dict(s)))
+        back = sample_from_dict(doc)
+        assert back.chips[0].info.family == "gpu"
+        assert back.chips[0].processes == s.chips[0].processes
+
+    def test_tpu_samples_omit_gpu_keys(self):
+        from tpu_pod_exporter.backend.recorded import sample_to_dict
+
+        s = FakeBackend(chips=1).sample()
+        doc = sample_to_dict(s)
+        assert "family" not in doc["chips"][0]
+        assert "procs" not in doc["chips"][0]
+
+    def test_gpu_demo_green(self, capsys):
+        assert run_gpu_demo(FIXTURE) == 0
+        assert "gpu-demo" in capsys.readouterr().out
+
+
+# ------------------------------------------------------------------- chaos
+
+
+class TestChaosNvmlShapes:
+    def test_err_device_nvml_code(self):
+        from tpu_pod_exporter.chaos import ChaosWrapper, parse_chaos_spec
+
+        rules = parse_chaos_spec("err:device:1:x1:nvml=gpu_is_lost")
+        w = ChaosWrapper(FakeBackend(chips=1), "device", rules, seed=1)
+        with pytest.raises(NvmlError) as ei:
+            w.sample()
+        assert ei.value.code == 15
+        assert w.sample().chips  # x1: next call passes through
+
+    @pytest.mark.parametrize("spec", (
+        "err:device:nvml=not_a_code",
+        "err:attribution:nvml=gpu_is_lost",
+        "hang:device:nvml=gpu_is_lost",
+    ))
+    def test_bad_nvml_rules_fail_loudly(self, spec):
+        from tpu_pod_exporter.chaos import parse_chaos_spec
+
+        with pytest.raises(ValueError):
+            parse_chaos_spec(spec)
+
+
+# ----------------------------------------------------------- mixed rollups
+
+
+class TestMixedFleetRollups:
+    def host_text(self, family: str, slice_name: str, host: str,
+                  used: float, total: float) -> str:
+        p = family
+        duty = ("gpu_utilization_percent" if family == "gpu"
+                else "tpu_tensorcore_duty_cycle_percent")
+        accel = "a100" if family == "gpu" else "v5p"
+        cl = (f'chip_id="0",device_path="",accelerator="{accel}",'
+              f'slice_name="{slice_name}",host="{host}",worker_id="0",'
+              f'pod="p-{family}",namespace="ns",container="c"')
+        return (
+            f'{p}_chip_info{{{cl},device_kind="",coords=""}} 1\n'
+            f'{p}_hbm_used_bytes{{{cl}}} {used}\n'
+            f'{p}_hbm_total_bytes{{{cl}}} {total}\n'
+            f'{duty}{{{cl}}} 50\n'
+        )
+
+    def aggregate(self, bodies: dict):
+        from tpu_pod_exporter.aggregate import SliceAggregator
+
+        store = SnapshotStore()
+        agg = SliceAggregator(
+            tuple(bodies), store, fetch=lambda t, timeout_s: bodies[t],
+        )
+        agg.poll_once()
+        agg.close()
+        return store.current()
+
+    def test_families_never_sum_together(self):
+        snap = self.aggregate({
+            "t0": self.host_text("tpu", "s-t", "h0", 100.0, 200.0),
+            "g0": self.host_text("gpu", "s-g", "g0", 40.0, 80.0),
+        })
+        assert snap.value("tpu_slice_hbm_used_bytes",
+                          ("s-t", "v5p", "tpu")) == 100.0
+        assert snap.value("tpu_slice_hbm_used_bytes",
+                          ("s-g", "a100", "gpu")) == 40.0
+        assert snap.value("tpu_fleet_family_chip_count", ("tpu",)) == 1.0
+        assert snap.value("tpu_fleet_family_chip_count", ("gpu",)) == 1.0
+        assert snap.value("tpu_fleet_family_hbm_used_bytes",
+                          ("tpu",)) == 100.0
+        assert snap.value("tpu_fleet_family_hbm_used_bytes",
+                          ("gpu",)) == 40.0
+
+    def test_gpu_utilization_folds_into_duty_rollup(self):
+        snap = self.aggregate({
+            "g0": self.host_text("gpu", "s-g", "g0", 40.0, 80.0),
+        })
+        assert snap.value(
+            "tpu_slice_tensorcore_duty_cycle_avg_percent",
+            ("s-g", "a100", "gpu"),
+        ) == 50.0
+
+    def test_leaf_component_family_roundtrips_to_root(self):
+        from tpu_pod_exporter.metrics import schema
+        from tpu_pod_exporter.shard import fold_leaf_body
+
+        samples = [
+            (schema.TPU_LEAF_SLICE_COMPONENT.name,
+             {"slice_name": "s", "accelerator": "a100", "family": "gpu",
+              "field": "chips"}, 4.0),
+            # A pre-family leaf's components default to the TPU family.
+            (schema.TPU_LEAF_SLICE_COMPONENT.name,
+             {"slice_name": "s", "accelerator": "v5p", "field": "chips"},
+             2.0),
+        ]
+        view = fold_leaf_body("leaf-0", samples)
+        assert view.slice_fields[("s", "a100", "gpu")]["chips"] == 4.0
+        assert view.slice_fields[("s", "v5p", "tpu")]["chips"] == 2.0
+
+    def test_history_fallback_probes_gpu_only_for_gpu_targets(self):
+        import urllib.error
+
+        from tpu_pod_exporter.aggregate import SliceAggregator
+
+        bodies = {
+            "t0": self.host_text("tpu", "s-t", "h0", 100.0, 200.0),
+            "g0": self.host_text("gpu", "s-g", "g0", 40.0, 80.0),
+        }
+        down: set = set()
+        calls: list[str] = []
+
+        def fetch(t, timeout_s):
+            if t in down:
+                raise ConnectionError("down")
+            return bodies[t]
+
+        def hist_fetch(url, timeout_s):
+            calls.append(url)
+            raise urllib.error.HTTPError(url, 404, "no samples", None, None)
+
+        store = SnapshotStore()
+        agg = SliceAggregator(("t0", "g0"), store, fetch=fetch,
+                              history_fallback_window_s=15.0,
+                              history_fetch=hist_fetch,
+                              breaker_failures=0)
+        try:
+            agg.poll_once()  # both up: the gpu-target latch learns g0
+            down.update(("t0", "g0"))
+            agg.poll_once()
+        finally:
+            agg.close()
+        by_target = {
+            "t0": [u for u in calls if "//t0" in u],
+            "g0": [u for u in calls if "//g0" in u],
+        }
+        assert not any("gpu_" in u for u in by_target["t0"])
+        assert any("gpu_hbm_used_bytes" in u for u in by_target["g0"])
+        assert len(by_target["t0"]) == 8
+        assert len(by_target["g0"]) == 14
+
+    def test_store_rules_aggregate_by_family(self):
+        from tpu_pod_exporter.metrics import SnapshotBuilder, schema
+        from tpu_pod_exporter.store import evaluate_rule, parse_rules
+
+        (rule,) = parse_rules(
+            "fleet:chips:by_family = sum(tpu_slice_chip_count) by (family)")
+        b = SnapshotBuilder()
+        b.declare(schema.TPU_SLICE_CHIP_COUNT)
+        b.add(schema.TPU_SLICE_CHIP_COUNT, 8.0, ("s0", "v5p", "tpu"))
+        b.add(schema.TPU_SLICE_CHIP_COUNT, 4.0, ("s1", "v5p", "tpu"))
+        b.add(schema.TPU_SLICE_CHIP_COUNT, 2.0, ("s2", "a100", "gpu"))
+        out = dict(
+            (labels["family"], value)
+            for labels, value in evaluate_rule(rule, b.build(timestamp=0.0))
+        )
+        assert out == {"tpu": 12.0, "gpu": 2.0}
+
+
+# ----------------------------------------------------------------- app wiring
+
+
+class TestAppWiring:
+    def test_backend_nvml_sim_flag(self):
+        from tpu_pod_exporter.app import build_backend
+        from tpu_pod_exporter.config import ExporterConfig
+
+        be = build_backend(ExporterConfig(backend="nvml", nvml_sim_gpus=3))
+        assert be.family == "gpu"
+        assert len(be.sample().chips) == 3
+
+    def test_backend_nvml_spec_file(self, tmp_path):
+        from tpu_pod_exporter.app import build_backend
+        from tpu_pod_exporter.config import ExporterConfig
+
+        spec = tmp_path / "sim.json"
+        spec.write_text(json.dumps(
+            {"gpus": [{"mem_total": 10, "mem_used": 4}]}))
+        be = build_backend(ExporterConfig(
+            backend="nvml", nvml_sim_spec=str(spec)))
+        (chip,) = be.sample().chips
+        assert chip.hbm_total_bytes == 10.0
+
+    def test_gpu_backend_selects_gpu_resource_name(self):
+        from tpu_pod_exporter.app import ExporterApp
+        from tpu_pod_exporter.config import ExporterConfig
+
+        cfg = ExporterConfig(backend="nvml", nvml_sim_gpus=1,
+                             attribution="none", history_retention_s=0.0,
+                             trace=False, phase_deadline_s=0.0, port=0)
+        app = ExporterApp(cfg)
+        try:
+            assert app.resource_name == "nvidia.com/gpu"
+        finally:
+            app.collector.close()
+
+    def test_farm_mixed_bodies(self):
+        from tpu_pod_exporter.loadgen.fleet import SynthTargetFarm
+
+        farm = SynthTargetFarm(16, chips=2, n_slices=8, gpu_slices=2)
+        try:
+            assert farm.family_of_slice(0) == "tpu"
+            assert farm.family_of_slice(7) == "gpu"
+            gpu_idx = next(i for i in range(16) if farm.family_of(i) == "gpu")
+            body = farm.body(gpu_idx)
+            assert "gpu_chip_info{" in body
+            assert "gpu_pod_memory_used_bytes{" in body
+            assert "tpu_chip_info{" not in body
+            tpu_body = farm.body(0)
+            assert "tpu_chip_info{" in tpu_body
+            assert "gpu_" not in tpu_body
+        finally:
+            farm.close()

@@ -194,7 +194,7 @@ class TestParseCacheChurnBounds:
         flap_layout = agg._parse_layouts["flap:8000"]
         for lo in agg._parse_layouts.values():
             lo.max_entries = base.count("\n") + 10
-        key = {"slice_name": "slice-a", "accelerator": "v5p-64"}
+        key = {"slice_name": "slice-a", "accelerator": "v5p-64", "family": "tpu"}
         try:
             for r in range(200):
                 fetch.round = r

@@ -542,7 +542,7 @@ class TestAggregatorFallback:
 
     def test_missed_round_keeps_slice_continuity(self):
         snap = self._aggregate(self._hist_fetch)
-        key = ("s", "v5p-8")
+        key = ("s", "v5p-8", "tpu")
         # h1's chips stay in the rollups via its flight recorder...
         assert snap.value("tpu_slice_hosts_reporting", key) == 2.0
         assert snap.value("tpu_slice_chip_count", key) == 2.0
@@ -565,7 +565,7 @@ class TestAggregatorFallback:
             raise ConnectionError("history down too")
 
         snap = self._aggregate(dead)
-        key = ("s", "v5p-8")
+        key = ("s", "v5p-8", "tpu")
         assert snap.value("tpu_slice_hosts_reporting", key) == 1.0
         assert snap.value("tpu_slice_chip_count", key) == 1.0
         assert snap.value(
@@ -597,8 +597,8 @@ class TestAggregatorFallback:
             raise urllib.error.HTTPError(url, 404, "no samples", None, None)
 
         snap = self._aggregate(sparse)
-        assert len(calls) == 8  # every fallback metric probed
-        key = ("s", "v5p-8")
+        assert len(calls) == 8  # every TPU fallback metric probed (gpu_* probes are gated on the target having ever served a gpu_ family)
+        key = ("s", "v5p-8", "tpu")
         assert snap.value("tpu_slice_hbm_used_bytes", key) == 177.0
 
     def test_disabled_by_default(self):

@@ -288,6 +288,13 @@ class TestRecordingRulesEquivalence:
         assert values, f"{record} evaluated to no series"
         for key, rule_value in values.items():
             labels = dict(zip(by, key))
+            if agg_name.startswith("tpu_slice_"):
+                # The aggregator's slice rollups carry the accelerator-
+                # family key (SLICE_LABELS); the PromQL rules aggregate
+                # tpu_* node series only, so their output is implicitly
+                # the TPU family (a mixed fleet's gpu_* families need the
+                # parallel rules sketched in prometheus-rules.yaml).
+                labels["family"] = "tpu"
             if "pod" in by and labels.get("pod", "") == "":
                 # The aggregator (like the exporter) never mints a
                 # workload series for unattributed chips; the PromQL sum

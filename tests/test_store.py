@@ -69,8 +69,8 @@ def fleet_snapshot(r, n_targets=4, n_slices=2, wall=BASE_WALL):
               0.0 if (i + r) % 19 == 0 else 1.0, (f"t{i}",))
     for sl in range(n_slices):
         b.add(schema.TPU_SLICE_HBM_USED_BYTES,
-              float(1000 * (sl + 1) + r), (f"slice-{sl}", "v5p"))
-        b.add(schema.TPU_SLICE_CHIP_COUNT, 8.0, (f"slice-{sl}", "v5p"))
+              float(1000 * (sl + 1) + r), (f"slice-{sl}", "v5p", "tpu"))
+        b.add(schema.TPU_SLICE_CHIP_COUNT, 8.0, (f"slice-{sl}", "v5p", "tpu"))
     return b.build(timestamp=wall)
 
 
@@ -384,7 +384,7 @@ class TestTornSegmentFuzz:
 
     def _restored_ids(self, store, step=10.0):
         key = series_key(schema.TPU_SLICE_HBM_USED_BYTES.name,
-                         {"slice_name": "slice-0", "accelerator": "v5p"})
+                         {"slice_name": "slice-0", "accelerator": "v5p", "family": "tpu"})
         s = store._series.get(key)
         if s is None:
             return []
@@ -587,7 +587,7 @@ class TestStoreQueryPlane:
         wall = feed_rounds(st, 8)
         live = FakeLivePlane(live_rows if live_rows is not None else [{
             "metric": self.HBM,
-            "labels": {"slice_name": "slice-0", "accelerator": "v5p"},
+            "labels": {"slice_name": "slice-0", "accelerator": "v5p", "family": "tpu"},
             "values": [[wall, 1.0]],
         }])
         return StoreQueryPlane(live, st), st, wall

@@ -73,6 +73,15 @@ CONSUMED_NAMES = frozenset({
     "tpu_host_info",
     "tpu_pod_chip_count",
     "tpu_pod_hbm_used_bytes",
+    # The GPU device family's node surface (backend/nvml.py): same fold
+    # slots, family-keyed slice accumulators — a mixed fleet's sums never
+    # cross families.
+    "gpu_chip_info",
+    "gpu_hbm_used_bytes",
+    "gpu_hbm_total_bytes",
+    "gpu_utilization_percent",
+    "gpu_pod_chip_count",
+    "gpu_pod_memory_used_bytes",
 })
 
 log = logging.getLogger("tpu_pod_exporter.aggregate")
@@ -121,7 +130,7 @@ def default_fetch(target: str, timeout_s: float,
 
 
 class _SliceAgg:
-    """Mutable per-(slice, accelerator) accumulator for one round."""
+    """Mutable per-(slice, accelerator, family) accumulator for one round."""
 
     __slots__ = ("hosts", "chip_series_hosts", "chips", "hbm_used",
                  "hbm_total", "used_chips", "total_chips", "duty_sum",
@@ -189,7 +198,7 @@ class _GroupAgg:
                  "ici_bw", "ici_n", "dcn_bw", "dcn_n", "expected_slices")
 
     def __init__(self) -> None:
-        self.slices: set[tuple[str, str]] = set()
+        self.slices: set[tuple[str, str, str]] = set()
         # Count, not a set: slice hosts are disjoint (one host belongs to
         # one slice), so summing per-slice counts equals the union size —
         # and the root tier only has counts to sum.
@@ -280,13 +289,45 @@ def emit_rollups(b: SnapshotBuilder, slices, workloads, slice_groups,
         if agg.dcn_n:
             b.add(schema.TPU_SLICE_DCN_BYTES_PER_SECOND, agg.dcn_bw, key)
 
+    # Per-family fleet rollups: the slice sums grouped by the accelerator
+    # family key (key[2]) — published rather than derived so mixed-fleet
+    # dashboards and the store's `by (family)` rules never sum across
+    # families by accident. Same absent-beats-fake-zero guards.
+    fam_hosts: dict[str, float] = {}
+    fam_chips: dict[str, float] = {}
+    fam_used: dict[str, list[float]] = {}   # [sum, n]
+    fam_total: dict[str, list[float]] = {}  # [sum, n]
+    for key, agg in slices.items():
+        fam = key[2] if len(key) > 2 else "tpu"
+        fam_hosts[fam] = fam_hosts.get(fam, 0.0) + agg.hosts_n
+        fam_chips[fam] = fam_chips.get(fam, 0.0) + agg.chips
+        u = fam_used.setdefault(fam, [0.0, 0.0])
+        u[0] += agg.hbm_used
+        u[1] += agg.used_n
+        t = fam_total.setdefault(fam, [0.0, 0.0])
+        t[0] += agg.hbm_total
+        t[1] += agg.total_n
+    for fam in fam_chips:
+        fkey = (fam,)
+        b.add(schema.TPU_FLEET_FAMILY_HOSTS_REPORTING, fam_hosts[fam], fkey)
+        b.add(schema.TPU_FLEET_FAMILY_CHIP_COUNT, fam_chips[fam], fkey)
+        if fam_used[fam][1]:
+            b.add(schema.TPU_FLEET_FAMILY_HBM_USED_BYTES,
+                  fam_used[fam][0], fkey)
+        if fam_total[fam][1]:
+            b.add(schema.TPU_FLEET_FAMILY_HBM_TOTAL_BYTES,
+                  fam_total[fam][0], fkey)
+
     # Multi-slice group rollups: join slices to groups via the
     # tpu_host_info membership map (BASELINE config 5). A slice without
     # a group (single-slice deployment) contributes to no group series,
     # and every sum keeps the absent-beats-fake-zero sample-count guards.
+    # Membership is keyed (slice_name, accelerator) — tpu_host_info
+    # carries no family — so the slice key's family element is dropped
+    # for the lookup.
     groups: dict[str, _GroupAgg] = {}
     for skey, agg in slices.items():
-        membership = slice_groups.get(skey)
+        membership = slice_groups.get(tuple(skey)[:2])
         if membership is None:
             continue
         group, nslices_str = membership
@@ -740,6 +781,11 @@ class SliceAggregator:
         )
         self._wallclock = wallclock
         self._counters = CounterStore()
+        # Targets that have ever served a gpu_* family (the aggregator-side
+        # twin of the collector's _gpu_surface latch): the history fallback
+        # probes GPU metrics only for these, so a missed round on a
+        # homogeneous TPU fleet costs zero can-only-404 requests.
+        self._gpu_targets: set[str] = set()
         self._rlog = RateLimitedLogger(log)
         # Latency distributions (same contract as the exporter's: p99
         # computable from the exposition). Round durations observe after
@@ -816,6 +862,7 @@ class SliceAggregator:
                 for t in self._tset.targets
             }
             self._counters.prune(keep)
+            self._gpu_targets &= set(self._tset.targets)
         round_targets = self._tset.targets
         tr = self._tracer.start_poll() if self._tracer is not None else None
         # Round-local quarantine set: targets whose breaker skipped the
@@ -943,7 +990,7 @@ class SliceAggregator:
         base = target_base_url(target)
         window = self._history_window_s
         samples: list[tuple[str, dict, float]] = []
-        for metric, synth_name, use_rate in (
+        probes = [
             ("tpu_chip_info", "tpu_chip_info", False),
             ("tpu_hbm_used_bytes", "tpu_hbm_used_bytes", False),
             ("tpu_hbm_total_bytes", "tpu_hbm_total_bytes", False),
@@ -958,7 +1005,22 @@ class SliceAggregator:
              "tpu_ici_link_bandwidth_bytes_per_second", True),
             ("tpu_dcn_transferred_bytes_total",
              "tpu_dcn_link_bandwidth_bytes_per_second", True),
-        ):
+        ]
+        if target in self._gpu_targets:
+            # GPU-family twins, only for targets that have ever served a
+            # gpu_* family: a homogeneous TPU fleet's missed rounds never
+            # pay six can-only-404 probes inside the degraded window.
+            probes += [
+                ("gpu_chip_info", "gpu_chip_info", False),
+                ("gpu_hbm_used_bytes", "gpu_hbm_used_bytes", False),
+                ("gpu_hbm_total_bytes", "gpu_hbm_total_bytes", False),
+                ("gpu_utilization_percent", "gpu_utilization_percent",
+                 False),
+                ("gpu_pod_chip_count", "gpu_pod_chip_count", False),
+                ("gpu_pod_memory_used_bytes", "gpu_pod_memory_used_bytes",
+                 False),
+            ]
+        for metric, synth_name, use_rate in probes:
             url = f"{base}/api/v1/window_stats?metric={metric}&window={window:g}"
             try:
                 doc = self._history_fetch(url, self._timeout_s)
@@ -1020,7 +1082,8 @@ class SliceAggregator:
         fallbacks = fallbacks or {}
         quarantined = quarantined or set()
 
-        slices: dict[tuple[str, str], _SliceAgg] = {}
+        # (slice_name, accelerator, family) -> accumulator
+        slices: dict[tuple[str, str, str], _SliceAgg] = {}
         workloads: dict[tuple[str, str, str], _WorkloadAgg] = {}
         # (slice_name, accelerator) -> (multislice_group, num_slices str)
         slice_groups: dict[tuple[str, str], tuple[str, str]] = {}
@@ -1043,6 +1106,12 @@ class SliceAggregator:
                         f"parse:{target}", "bad exposition from %s: %s", target, e
                     )
                 else:
+                    if target not in self._gpu_targets and any(
+                        s[0].startswith("gpu_") for s in samples
+                    ):
+                        # Cheap: samples are already filtered to
+                        # CONSUMED_NAMES (a handful of rows per chip).
+                        self._gpu_targets.add(target)
                     self._consume(samples, slices, workloads, slice_groups)
             if not ok:
                 # A quarantined round was SKIPPED, not attempted — the
@@ -1150,16 +1219,20 @@ class SliceAggregator:
         """Fold one host's parsed ``(name, labels, value)`` tuples into the
         round accumulators. The name dispatch is ordered by sample
         frequency — per-link ICI rows are ~60% of a 256-chip body's
-        consumed lines (6 links/chip), so they test first."""
+        consumed lines (6 links/chip), so they test first. GPU-family
+        names (``gpu_*``, backend/nvml.py) fold into the same accumulator
+        slots under ``family="gpu"`` slice keys: the node's metric
+        namespace IS the family marker, so one fold path serves both
+        device families without ever summing across them."""
         for name, labels, value in samples:
             if name == "tpu_ici_link_bandwidth_bytes_per_second":
-                agg = SliceAggregator._slice(slices, labels)
+                agg = SliceAggregator._slice(slices, labels, "tpu")
                 agg.ici_bw += value
                 agg.ici_n += 1
                 host = labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
-            elif name == "tpu_chip_info":
+            elif name == "tpu_chip_info" or name == "gpu_chip_info":
                 # The one guaranteed per-chip series (round 4: a chip whose
                 # HBM is unreadable publishes NO tpu_hbm_* series, so chip
                 # presence and hosts_reporting must not key off those).
@@ -1168,7 +1241,7 @@ class SliceAggregator:
                 # and a dual-source count (chip_info OR hbm series) would
                 # risk double-counting; mixed fleets older than that are
                 # not supported.
-                agg = SliceAggregator._slice(slices, labels)
+                agg = SliceAggregator._slice(slices, labels, name[:3])
                 agg.chips += 1
                 # A missing host label must not count as host "" — mixed
                 # with exporters that omit the label, all such hosts would
@@ -1177,29 +1250,30 @@ class SliceAggregator:
                 host = labels.get("host")
                 if host:
                     agg.hosts.add(host)
-            elif name == "tpu_hbm_used_bytes":
-                agg = SliceAggregator._slice(slices, labels)
+            elif name == "tpu_hbm_used_bytes" or name == "gpu_hbm_used_bytes":
+                agg = SliceAggregator._slice(slices, labels, name[:3])
                 agg.hbm_used += value
                 agg.used_chips.add(SliceAggregator._chip_key(labels))
                 host = labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
-            elif name == "tpu_hbm_total_bytes":
-                agg = SliceAggregator._slice(slices, labels)
+            elif name == "tpu_hbm_total_bytes" or name == "gpu_hbm_total_bytes":
+                agg = SliceAggregator._slice(slices, labels, name[:3])
                 agg.hbm_total += value
                 agg.total_chips.add(SliceAggregator._chip_key(labels))
                 host = labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
-            elif name == "tpu_tensorcore_duty_cycle_percent":
-                agg = SliceAggregator._slice(slices, labels)
+            elif name in ("tpu_tensorcore_duty_cycle_percent",
+                          "gpu_utilization_percent"):
+                agg = SliceAggregator._slice(slices, labels, name[:3])
                 agg.duty_sum += value
                 agg.duty_n += 1
                 host = labels.get("host")
                 if host:
                     agg.chip_series_hosts.add(host)
             elif name == "tpu_dcn_link_bandwidth_bytes_per_second":
-                agg = SliceAggregator._slice(slices, labels)
+                agg = SliceAggregator._slice(slices, labels, "tpu")
                 agg.dcn_bw += value
                 agg.dcn_n += 1
                 host = labels.get("host")
@@ -1216,15 +1290,19 @@ class SliceAggregator:
                         labels.get("accelerator", ""),
                     )
                     slice_groups[key] = (group, labels.get("num_slices", ""))
-            elif name in ("tpu_pod_chip_count", "tpu_pod_hbm_used_bytes"):
+            elif name in ("tpu_pod_chip_count", "tpu_pod_hbm_used_bytes",
+                          "gpu_pod_chip_count", "gpu_pod_memory_used_bytes"):
                 pod = labels.get("pod", "")
                 if not pod:
                     continue
+                # Workload rollups stay family-agnostic (a pod's chips are
+                # one family — slices are homogeneous node pools), so both
+                # namespaces fold into the same tpu_workload_* keys.
                 key = (pod, labels.get("namespace", ""), labels.get("slice_name", ""))
                 w = workloads.get(key)
                 if w is None:
                     w = workloads[key] = _WorkloadAgg()
-                if name == "tpu_pod_chip_count":
+                if name.endswith("_pod_chip_count"):
                     w.chips += value
                     host = labels.get("host")
                     if host:  # same missing-label rule as hosts_reporting
@@ -1239,8 +1317,10 @@ class SliceAggregator:
         return labels.get("host", ""), labels.get("chip_id", "")
 
     @staticmethod
-    def _slice(slices: dict, labels: dict[str, str]) -> _SliceAgg:
-        key = (labels.get("slice_name", ""), labels.get("accelerator", ""))
+    def _slice(slices: dict, labels: dict[str, str],
+               family: str = "tpu") -> _SliceAgg:
+        key = (labels.get("slice_name", ""), labels.get("accelerator", ""),
+               family)
         agg = slices.get(key)
         if agg is None:
             agg = slices[key] = _SliceAgg()

@@ -151,8 +151,16 @@ def _check_lock_io(tree: ast.Module, src_lines: list[str], relpath: str, ctx: "L
 
 # A string literal shaped like one of our metric families. The package name
 # itself matches the pattern; it (and module paths) are not metrics.
-_METRIC_SHAPED = re.compile(r"(?:tpu|pod_gpu|docker_gpu)_[a-z0-9_]+")
-_METRIC_STRING_ALLOWED = {"tpu_pod_exporter"}
+# gpu_ is the GPU device family's node namespace (backend/nvml.py) — it
+# resolves against metrics/schema.py exactly like tpu_; the pod_gpu/
+# docker_gpu alternatives (the reference's legacy alias names) sort before
+# gpu_ so they match whole.
+_METRIC_SHAPED = re.compile(r"(?:tpu|pod_gpu|docker_gpu|gpu)_[a-z0-9_]+")
+# Non-metric identifiers that happen to match the shape: the package name,
+# and gpu_-prefixed config/kwarg names (flags, result-dict keys).
+_METRIC_STRING_ALLOWED = {
+    "tpu_pod_exporter", "gpu_slices", "gpu_resource_name",
+}
 # Module-ish strings that happen to match the metric shape.
 _METRIC_STRING_ALLOWED_SUFFIXES = ("_pb2", "_pb2_grpc")
 

@@ -74,11 +74,32 @@ def _build_named_backend(choice: str, cfg: ExporterConfig) -> DeviceBackend:
         from tpu_pod_exporter.backend.libtpu import LibtpuMetricsBackend
 
         return LibtpuMetricsBackend(addr=cfg.libtpu_metrics_addr)
+    if choice == "nvml":
+        from tpu_pod_exporter.backend.nvml import (
+            NvmlBackend,
+            SimulatedNvmlDriver,
+            sim_driver_from_spec,
+        )
+
+        driver = None
+        if cfg.nvml_sim_spec:
+            import json
+
+            with open(cfg.nvml_sim_spec, encoding="utf-8") as f:
+                driver = sim_driver_from_spec(json.load(f))
+        elif cfg.nvml_sim_gpus > 0:
+            driver = SimulatedNvmlDriver(cfg.nvml_sim_gpus)
+        # driver=None → the real pynvml binding (BackendError naming the
+        # sim flags when the wheel is absent — explicit selection is loud).
+        return NvmlBackend(driver=driver)
     raise ValueError(f"unknown backend: {choice}")
 
 
-def build_attribution(cfg: ExporterConfig) -> AttributionProvider:
+def build_attribution(cfg: ExporterConfig,
+                      resource_name: str | None = None) -> AttributionProvider:
     choice = cfg.attribution
+    if resource_name is None:
+        resource_name = cfg.resource_name
     if choice == "auto":
         if os.path.exists(cfg.podresources_socket):
             choice = "podresources"
@@ -88,22 +109,25 @@ def build_attribution(cfg: ExporterConfig) -> AttributionProvider:
             log.info("no kubelet attribution source found; attribution disabled")
             return FakeAttribution()
         try:
-            return _build_named_attribution(choice, cfg)
+            return _build_named_attribution(choice, cfg, resource_name)
         except Exception as e:  # noqa: BLE001
             log.error("auto-selected %s attribution unavailable (%s); "
                       "attribution disabled", choice, e)
             return FakeAttribution()
-    return _build_named_attribution(choice, cfg)
+    return _build_named_attribution(choice, cfg, resource_name)
 
 
-def _build_named_attribution(choice: str, cfg: ExporterConfig) -> AttributionProvider:
+def _build_named_attribution(choice: str, cfg: ExporterConfig,
+                             resource_name: str | None = None) -> AttributionProvider:
+    if resource_name is None:
+        resource_name = cfg.resource_name
     if choice in ("fake", "none"):
         return FakeAttribution()
     if choice == "podresources":
         from tpu_pod_exporter.attribution.podresources import PodResourcesAttribution
 
         return PodResourcesAttribution(
-            socket_path=cfg.podresources_socket, resource_name=cfg.resource_name
+            socket_path=cfg.podresources_socket, resource_name=resource_name
         )
     if choice == "checkpoint":
         from tpu_pod_exporter.attribution.checkpoint import CheckpointAttribution
@@ -177,8 +201,17 @@ class ExporterApp:
         self.backend = _maybe_record(
             backend if backend is not None else build_backend(cfg), cfg
         )
+        # GPU-family backends join attribution on the GPU resource name
+        # (nvidia.com/gpu device-plugin UUIDs) — one DaemonSet codebase,
+        # the node pool's backend flag selects the family end to end.
+        self.resource_name = (
+            cfg.gpu_resource_name
+            if getattr(self.backend, "family", "tpu") == "gpu"
+            else cfg.resource_name
+        )
         self.attribution = (
-            attribution if attribution is not None else build_attribution(cfg)
+            attribution if attribution is not None
+            else build_attribution(cfg, self.resource_name)
         )
         topo = detect_host_topology(
             accelerator=cfg.accelerator,
@@ -393,7 +426,7 @@ class ExporterApp:
             attribution=self.attribution,
             store=self.store,
             topology=topo,
-            resource_name=cfg.resource_name,
+            resource_name=self.resource_name,
             attribution_max_stale_s=cfg.attribution_max_stale_s,
             legacy_metrics=cfg.legacy_metrics,
             process_scanner=scanner,
@@ -502,7 +535,7 @@ class ExporterApp:
                 "interval_s": self.cfg.interval_s,
                 "backend": getattr(self.backend, "name", "?"),
                 "attribution": getattr(self.attribution, "name", "?"),
-                "resource_name": self.cfg.resource_name,
+                "resource_name": self.resource_name,
                 "max_concurrent_scrapes": self.cfg.max_concurrent_scrapes,
                 "max_scrapes_per_s": self.cfg.max_scrapes_per_s,
                 # Effective (detected) membership, not the raw override —

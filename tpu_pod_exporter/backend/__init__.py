@@ -35,21 +35,27 @@ class BackendError(RuntimeError):
 
 @dataclass(frozen=True, slots=True)
 class ChipInfo:
-    """Static identity of one local TPU chip.
+    """Static identity of one local accelerator chip.
 
     ``chip_id`` is the stable per-host index (the analog of the NVML device
     index, ``main.go:123-124``). ``device_ids`` are the kubelet device-plugin
-    IDs this chip appears as in podresources (``google.com/tpu`` resource) —
-    the join key for attribution.
+    IDs this chip appears as in podresources (``google.com/tpu`` resource,
+    or GPU UUIDs for ``nvidia.com/gpu``) — the join key for attribution.
+    ``family`` selects the metric namespace the chip publishes under:
+    ``"tpu"`` (the default — every pre-GPU backend) or ``"gpu"`` (the
+    NVML-shaped backend), and rides the rollup tree as the per-family
+    aggregation key so mixed GPU/TPU fleets never sum across families.
     """
 
     chip_id: int
     device_path: str = ""
     device_ids: tuple[str, ...] = ()
     # Optional hardware identity, filled by backends that know it (jaxdev:
-    # Device.device_kind / .coords). Empty strings when unknown.
+    # Device.device_kind / .coords; nvml: the marketing name from
+    # DeviceGetName). Empty strings when unknown.
     device_kind: str = ""
     coords: str = ""  # torus position, e.g. "0,1,2"
+    family: str = "tpu"  # accelerator family: "tpu" | "gpu"
 
     def __post_init__(self) -> None:
         if not self.device_ids:
@@ -67,6 +73,18 @@ class IciLinkSample(NamedTuple):
 
     link: str                      # stable link id, e.g. "0".."5" (3D torus: ±x,±y,±z)
     transferred_bytes_total: float # monotonic since runtime start
+
+
+class DeviceProcessSample(NamedTuple):
+    """One process's device-memory footprint on one chip, as reported by the
+    device runtime itself (NVML ``GetComputeRunningProcesses``,
+    ``main.go:134-138``). TPU runtimes pin whole chips and serve no
+    per-process table, so TPU backends leave ``ChipSample.processes`` empty;
+    the procfs scanner remains the TPU-side process dimension."""
+
+    pid: int
+    used_bytes: float
+    comm: str = ""
 
 
 class ChipSample(NamedTuple):
@@ -89,6 +107,12 @@ class ChipSample(NamedTuple):
     # deployments) cumulative traffic counters — same shape as ici_links,
     # empty on runtimes/surfaces that don't serve them.
     dcn_links: tuple[IciLinkSample, ...] = ()
+    # Per-process device memory, from runtimes that report it (NVML
+    # GetComputeRunningProcesses). Empty on TPU backends — see
+    # DeviceProcessSample. For GPU chips, tensorcore_duty_cycle_percent
+    # carries the NVML utilization rate (GetUtilizationRates.gpu) and the
+    # collector publishes it as gpu_utilization_percent.
+    processes: tuple[DeviceProcessSample, ...] = ()
 
 
 class HostSample(NamedTuple):
@@ -105,6 +129,11 @@ class DeviceBackend(abc.ABC):
     drop-in."""
 
     name: str = "abstract"
+    # Accelerator family this backend serves ("tpu" | "gpu"): selects the
+    # metric namespace for backend-level series (gpu_backend_up) and the
+    # default ChipInfo.family its chips carry. Advisory — per-chip family
+    # is authoritative for per-chip series.
+    family: str = "tpu"
 
     @abc.abstractmethod
     def sample(self) -> HostSample:
@@ -121,6 +150,7 @@ __all__ = [
     "ChipInfo",
     "ChipSample",
     "DeviceBackend",
+    "DeviceProcessSample",
     "FakeBackend",
     "FakeChipScript",
     "HostSample",

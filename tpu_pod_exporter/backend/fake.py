@@ -110,10 +110,16 @@ class FakeBackend(DeviceBackend):
         chips: int | Sequence[ChipInfo] = 0,
         script: FakeChipScript | Sequence[FakeChipScript] | None = None,
         device_path_fmt: str = "/dev/accel{chip_id}",
+        family: str = "tpu",
     ) -> None:
+        # A GPU-family fake (family="gpu") models an NVML-backed node for
+        # mixed-fleet tests without the nvml module: chips publish under
+        # the gpu_* namespace via ChipInfo.family, exactly like NvmlBackend.
+        self.family = family
         if isinstance(chips, int):
             self._infos = tuple(
-                ChipInfo(chip_id=i, device_path=device_path_fmt.format(chip_id=i))
+                ChipInfo(chip_id=i, device_path=device_path_fmt.format(chip_id=i),
+                         family=family)
                 for i in range(chips)
             )
         else:

@@ -12,8 +12,17 @@ old recordings replay unchanged):
     {"chips": [{"chip_id": 0, "device_path": "...", "device_ids": ["0"],
                 "hbm_used": N, "hbm_total": N, "duty": N|null,
                 "ici": {"0": N, ...}, "dcn": {"0": N, ...}?,
-                "peak": N?, "device_kind": "..."?, "coords": "..."?}, ...],
+                "peak": N?, "device_kind": "..."?, "coords": "..."?,
+                "family": "gpu"?, "procs": [[pid, used_bytes, "comm"], ...]?},
+               ...],
      "partial_errors": ["..."]}
+
+GPU samples (the NVML-shaped backend) ride the same schema: ``family``
+marks the chip's namespace (omitted = "tpu", so every pre-GPU recording
+replays unchanged), ``duty`` carries the NVML utilization rate, and
+``procs`` carries the per-process device-memory table — the committed
+``tests/fixtures/gpu-recorded.jsonl`` runs the whole GPU path
+deterministically without a driver.
 """
 
 from __future__ import annotations
@@ -27,6 +36,7 @@ from tpu_pod_exporter.backend import (
     ChipInfo,
     ChipSample,
     DeviceBackend,
+    DeviceProcessSample,
     HostSample,
     IciLinkSample,
 )
@@ -60,6 +70,12 @@ def sample_to_dict(sample: HostSample) -> dict:
             doc["device_kind"] = c.info.device_kind
         if c.info.coords:
             doc["coords"] = c.info.coords
+        if c.info.family != "tpu":  # omitted = tpu: old recordings replay unchanged
+            doc["family"] = c.info.family
+        if c.processes:
+            doc["procs"] = [
+                [p.pid, p.used_bytes, p.comm] for p in c.processes
+            ]
         chips.append(doc)
     return {
         "chips": chips,
@@ -78,6 +94,7 @@ def sample_from_dict(doc: dict) -> HostSample:
                     device_ids=tuple(c.get("device_ids") or [str(c["chip_id"])]),
                     device_kind=c.get("device_kind", ""),
                     coords=c.get("coords", ""),
+                    family=str(c.get("family", "tpu")),
                 ),
                 hbm_used_bytes=(
                     None if c["hbm_used"] is None else float(c["hbm_used"])
@@ -103,6 +120,13 @@ def sample_from_dict(doc: dict) -> HostSample:
                         (c.get("dcn") or {}).items(), key=_link_sort_key
                     )
                 ),
+                processes=tuple(
+                    DeviceProcessSample(
+                        pid=int(p[0]), used_bytes=float(p[1]),
+                        comm=str(p[2]) if len(p) > 2 else "",
+                    )
+                    for p in (c.get("procs") or ())
+                ),
             )
         )
     return HostSample(
@@ -122,6 +146,7 @@ class RecordingBackend(DeviceBackend):
         self._sink: IO[str] = open(sink, "a") if isinstance(sink, str) else sink
         self._lock = threading.Lock()
         self.name = f"recording({inner.name})"
+        self.family = getattr(inner, "family", "tpu")
 
     def sample(self) -> HostSample:
         sample = self._inner.sample()  # BackendError propagates untouched
@@ -168,6 +193,12 @@ class RecordedBackend(DeviceBackend):
             raise BackendError(f"cannot read recording {path}: {e}") from e
         if not self._samples:
             raise BackendError(f"recording {path} is empty")
+        # A replayed GPU recording keeps its family: gpu_backend_up and the
+        # gpu_* surface come up exactly as they would against the live
+        # NVML backend the trace was captured from.
+        first_chips = self._samples[0].chips
+        if first_chips and all(c.info.family == "gpu" for c in first_chips):
+            self.family = "gpu"
         self._loop = loop
         self._i = 0
         self._lock = threading.Lock()

@@ -91,6 +91,11 @@ class ChaosRule:
     duration_s: float | None = None  # hang/slow length; kind-default if None
     max_count: int | None = None     # total injection cap; None = unlimited
     min_index: int = 0               # rule armed from this call index on (@N)
+    # err:device rules may speak exact NVML error shapes
+    # (``err:device:1:nvml=gpu_is_lost``): the injected exception is an
+    # NvmlError carrying this code, so GPU-path drills exercise the same
+    # typed failures the reference dies on (main.go:119-137).
+    nvml_code: str = ""
     fired: int = field(default=0, compare=False)
 
     @property
@@ -145,6 +150,19 @@ def parse_chaos_spec(spec: str) -> list[ChaosRule]:
             m = _OFFSET_RE.match(tok)
             if m:
                 rule.min_index = int(m.group(1))
+                continue
+            if tok.startswith("nvml="):
+                if kind != "err" or source != "device":
+                    raise ValueError(
+                        f"chaos rule {raw!r}: nvml= codes only apply to "
+                        f"err:device rules (the NVML-shaped GPU backend)"
+                    )
+                from tpu_pod_exporter.backend.nvml import normalize_nvml_code
+
+                try:
+                    rule.nvml_code = normalize_nvml_code(tok[5:])[0]
+                except ValueError as e:
+                    raise ValueError(f"chaos rule {raw!r}: {e}") from None
                 continue
             try:
                 p = float(tok)
@@ -313,6 +331,13 @@ class ChaosWrapper:
                 # call — a wedged-then-released source returns real data.
                 self._sleep(triggered.effective_duration_s)
             elif triggered.kind == "err":
+                if triggered.nvml_code:
+                    from tpu_pod_exporter.backend.nvml import NvmlError
+
+                    raise NvmlError(
+                        f"chaos: injected {self.source} error (call {idx})",
+                        triggered.nvml_code,
+                    )
                 raise ChaosError(
                     f"chaos: injected {self.source} error (call {idx})"
                 )

@@ -145,6 +145,11 @@ class Collector:
         self._resource_name = resource_name
         self._attribution_max_stale_s = attribution_max_stale_s
         self._legacy_metrics = legacy_metrics
+        # GPU surface latch: once the backend (or any observed chip) is
+        # GPU-family, the gpu_* twins are declared every poll — sticky, so
+        # scrapers see a stable surface from the first GPU sighting on
+        # (the same conditional-surface rule as TPU_CHIP_PROCESS_INFO).
+        self._gpu_surface = getattr(backend, "family", "tpu") == "gpu"
         self._clock = clock
         self._wallclock = wallclock
 
@@ -570,6 +575,15 @@ class Collector:
                  holders: Sequence[Any] | None = None) -> "Snapshot":
         b = SnapshotBuilder(prefix_cache=self._prefix_cache)
 
+        # GPU-family detection BEFORE the declares: a recorded/fake mixed
+        # host whose first GPU chip appears this poll must declare the
+        # gpu_* families in the same snapshot that carries their samples.
+        if not self._gpu_surface and host_sample is not None:
+            for c in host_sample.chips:
+                if c.info.family == "gpu":
+                    self._gpu_surface = True
+                    break
+
         # Declare the full schema up front so families are present (and typed)
         # even when sample-less — scrapers see a stable surface from poll #1.
         for spec in schema.ALL_SPECS:
@@ -582,6 +596,9 @@ class Collector:
             b.declare(schema.LEGACY_POD_MEMORY_PERC_USAGE)
         if self._process_scanner is not None:
             b.declare(schema.TPU_CHIP_PROCESS_INFO)
+        if self._gpu_surface:
+            for spec in schema.GPU_NODE_SPECS:
+                b.declare(spec)
 
         # device_path -> holders, for the per-chip process join. Holder sets
         # are tiny (≈ one workload process per chip), so a plain dict-of-lists
@@ -591,7 +608,10 @@ class Collector:
             for h in holders:
                 holders_by_path.setdefault(h.device_path, []).append(h)
 
-        # labels -> [chips, hbm_used, chips_with_readable_hbm]
+        # (family, *pod labels) -> [chips, hbm_used, chips_with_readable_hbm]
+        # — family-keyed so a mixed host (recorded/fake) rolls each pod up
+        # under its own namespace (tpu_pod_* vs gpu_pod_*), never summed
+        # across families.
         pod_rollup: dict[tuple[str, ...], list[float]] = {}
         # (pod, pid) -> [hbm_used, hbm_total] for the legacy aliases; pid is
         # "" when no process scanner or no holder was seen for the chip.
@@ -611,6 +631,15 @@ class Collector:
             hbm_peak_s = b.series(schema.TPU_HBM_PEAK_BYTES)
             chip_info_s = b.series(schema.TPU_CHIP_INFO)
             duty_s = b.series(schema.TPU_TENSORCORE_DUTY_CYCLE_PERCENT)
+            if self._gpu_surface:
+                # The gpu_* twins; the per-chip loop below selects handles
+                # by ChipInfo.family (one compare per chip — free next to
+                # the dict stores it gates).
+                g_used_s = b.series(schema.GPU_HBM_USED_BYTES)
+                g_total_s = b.series(schema.GPU_HBM_TOTAL_BYTES)
+                g_pct_s = b.series(schema.GPU_HBM_USED_PERCENT)
+                g_util_s = b.series(schema.GPU_UTILIZATION_PERCENT)
+                g_info_s = b.series(schema.GPU_CHIP_INFO)
             ici_total_s = b.series(schema.TPU_ICI_TRANSFERRED_BYTES_TOTAL)
             ici_bw_s = b.series(schema.TPU_ICI_LINK_BANDWIDTH_BYTES_PER_SECOND)
             dcn_total_s = b.series(schema.TPU_DCN_TRANSFERRED_BYTES_TOTAL)
@@ -674,25 +703,49 @@ class Collector:
                     cached = (chip_tuple, {}, info_tuple)
                     label_cache[cache_key] = cached
                 chip_tuple, link_tuples, info_tuple = cached
+                # Family dispatch: one string compare per chip selects the
+                # tpu_* or gpu_* series handles — the label schema is
+                # shared, only the namespace differs.
+                fam = info.family
+                if fam == "gpu":
+                    used_sel, total_sel = g_used_s, g_total_s
+                    pct_sel, duty_sel, info_sel = g_pct_s, g_util_s, g_info_s
+                else:
+                    used_sel, total_sel = hbm_used_s, hbm_total_s
+                    pct_sel, duty_sel, info_sel = hbm_pct_s, duty_s, chip_info_s
                 # None = backend couldn't read HBM (tunnel with empty
                 # memory_stats): publish no series — absent beats fake-zero
                 # (main.go:129-132 never publishes an unread value).
                 used = chip.hbm_used_bytes
                 total_b = chip.hbm_total_bytes
                 if used is not None:
-                    hbm_used_s[chip_tuple] = used
+                    used_sel[chip_tuple] = used
                 if total_b is not None:
-                    hbm_total_s[chip_tuple] = total_b
+                    total_sel[chip_tuple] = total_b
                 if used is not None and total_b is not None and total_b > 0:
                     # hbm_used_percent inlined (analog of main.go:149-150).
                     # total==0 ⇒ omit the series: a percent of a zero/unread
                     # total is undefined, and 0.0 would read as "idle".
-                    hbm_pct_s[chip_tuple] = used / total_b * 100.0
-                if chip.hbm_peak_bytes is not None:
+                    pct_sel[chip_tuple] = used / total_b * 100.0
+                if chip.hbm_peak_bytes is not None and fam == "tpu":
+                    # No gpu twin: NVML serves no allocator high-water mark.
                     hbm_peak_s[chip_tuple] = chip.hbm_peak_bytes
                 if chip.tensorcore_duty_cycle_percent is not None:
-                    duty_s[chip_tuple] = chip.tensorcore_duty_cycle_percent
-                chip_info_s[info_tuple] = 1.0
+                    # For GPU chips this slot carries the NVML utilization
+                    # rate (GetUtilizationRates.gpu) — see ChipSample.
+                    duty_sel[chip_tuple] = chip.tensorcore_duty_cycle_percent
+                info_sel[info_tuple] = 1.0
+                if fam == "gpu" and chip.processes:
+                    # The runtime's own per-process table
+                    # (GetComputeRunningProcesses, main.go:134-155): honest
+                    # host PIDs straight from the driver, pod attribution
+                    # from the same device-ID join as every chip series.
+                    for pr in chip.processes:
+                        b.add(
+                            schema.GPU_PROCESS_MEMORY_USED_BYTES,
+                            pr.used_bytes,
+                            chip_tuple + (str(pr.pid), pr.comm),
+                        )
 
                 # Link work is deferred to the fold pass below; here the fast
                 # path only verifies layout identity and extracts raw totals.
@@ -730,7 +783,7 @@ class Collector:
                         )
 
                 if owner is not None:
-                    rk = (owner.pod, owner.namespace) + self._topo_tuple
+                    rk = (fam, owner.pod, owner.namespace) + self._topo_tuple
                     # [chips, hbm_used, chips_with_readable_hbm]
                     agg = pod_rollup.setdefault(rk, [0.0, 0.0, 0])
                     agg[0] += 1.0
@@ -769,9 +822,16 @@ class Collector:
             self._prev_ici_at = now_mono
 
         for rk, (nchips, hbm, readable) in pod_rollup.items():
-            b.add(schema.TPU_POD_CHIP_COUNT, nchips, rk)
+            # rk[0] is the family key; the published labels are rk[1:].
+            if rk[0] == "gpu":
+                count_spec = schema.GPU_POD_CHIP_COUNT
+                mem_spec = schema.GPU_POD_MEMORY_USED_BYTES
+            else:
+                count_spec = schema.TPU_POD_CHIP_COUNT
+                mem_spec = schema.TPU_POD_HBM_USED_BYTES
+            b.add(count_spec, nchips, rk[1:])
             if readable:
-                b.add(schema.TPU_POD_HBM_USED_BYTES, hbm, rk)
+                b.add(mem_spec, hbm, rk[1:])
         for (pod, pid), (hbm, hbm_total) in legacy_rollup.items():
             # Reference-name aliases (main.go:24,31), label shape {pid, pod}.
             b.add(schema.LEGACY_POD_MEMORY_USAGE, hbm, (pid, pod))
@@ -796,6 +856,11 @@ class Collector:
 
         # Self-metrics (SURVEY.md §5).
         b.add(schema.TPU_EXPORTER_UP, 1.0 if stats.ok else 0.0)
+        if self._gpu_surface:
+            # Per-backend up for the GPU family: tracks the device half of
+            # the poll (a GPU-node wedge drops this exactly the way a TPU
+            # node drops tpu_exporter_up — the mixed-wedge drill's parity).
+            b.add(schema.GPU_BACKEND_UP, 1.0 if stats.ok else 0.0)
         # Warm-start markers: every LIVE poll publishes 0 — a restored
         # exposition (persist.RestoredSnapshot) patches these two values to
         # 1 / the measured staleness, which only works because the series

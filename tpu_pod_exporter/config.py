@@ -20,10 +20,22 @@ class ExporterConfig:
     port: int = 8000
     host: str = "0.0.0.0"
     interval_s: float = 1.0
-    backend: str = "auto"          # auto | fake | jax | libtpu | recorded
+    backend: str = "auto"          # auto | fake | jax | libtpu | recorded | nvml
     attribution: str = "auto"      # auto | fake | podresources | checkpoint | none
     resource_name: str = "google.com/tpu"
+    # Kubelet resource name GPU-family backends join attribution on (the
+    # nvidia device plugin advertises GPUs by UUID under this name); used
+    # in place of --resource-name when the backend family is "gpu".
+    gpu_resource_name: str = "nvidia.com/gpu"
     fake_chips: int = 0            # chip count when backend=fake
+    # Simulated NVML driver (backend=nvml without an NVIDIA driver): GPU
+    # count for the default scripted tables. 0 = use the real pynvml
+    # binding (or --nvml-sim-spec).
+    nvml_sim_gpus: int = 0
+    # JSON spec for the simulated NVML driver (per-GPU memory/utilization/
+    # process tables + injectable NVML error codes — see
+    # backend/nvml.py:sim_driver_from_spec). Wins over --nvml-sim-gpus.
+    nvml_sim_spec: str = ""
     recording_path: str = ""       # JSONL trace to replay when backend=recorded
     record_to: str = ""            # if set, record every poll's samples here
     podresources_socket: str = "/var/lib/kubelet/pod-resources/kubelet.sock"

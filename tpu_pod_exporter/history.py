@@ -95,9 +95,26 @@ HISTORY_TRACKED_METRICS: frozenset[str] = frozenset({
     "tpu_kubelet_allocated_chips",
     "tpu_exporter_up",
     "tpu_exporter_slow_polls_total",
+    # The GPU device family's node surface (backend/nvml.py): same
+    # forensics contract as the TPU twins — "what did GPU 0's memory do
+    # over the last five minutes" must answer node-locally, and the
+    # aggregator's missed-round history fallback probes these names. On
+    # TPU-only exporters the families carry no samples, so tracking them
+    # costs nothing (series are created per sample, not per name).
+    "gpu_hbm_used_bytes",
+    "gpu_hbm_total_bytes",
+    "gpu_hbm_used_percent",
+    "gpu_utilization_percent",
+    "gpu_chip_info",
+    "gpu_pod_chip_count",
+    "gpu_pod_memory_used_bytes",
+    "gpu_backend_up",
 })
 
-_SPEC_BY_NAME = {spec.name: spec for spec in schema.ALL_SPECS}
+_SPEC_BY_NAME = {
+    spec.name: spec
+    for spec in schema.ALL_SPECS + schema.GPU_NODE_SPECS
+}
 _COUNTER_METRICS = frozenset(
     name for name, spec in _SPEC_BY_NAME.items() if spec.type == schema.COUNTER
 )

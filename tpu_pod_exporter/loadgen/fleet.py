@@ -354,12 +354,21 @@ class SynthTargetFarm:
     503 — permanently-down hosts for the breaker-carryover assertions."""
 
     def __init__(self, n_targets: int, chips: int = 2, n_slices: int = 8,
-                 host: str = "127.0.0.1", port: int = 0) -> None:
+                 host: str = "127.0.0.1", port: int = 0,
+                 gpu_slices: int = 0) -> None:
         import http.server
 
         self.n_targets = n_targets
         self.chips = chips
         self.n_slices = n_slices
+        # Mixed fleet: the LAST gpu_slices slices are GPU node pools —
+        # their targets publish the gpu_* node surface (backend/nvml.py's
+        # namespace) instead of tpu_*, still pure functions of
+        # (idx, round), so the flat oracle sees identical bytes and
+        # per-family root-vs-oracle equality stays exact.
+        if not 0 <= gpu_slices <= n_slices:
+            raise ValueError("gpu_slices must be within [0, n_slices]")
+        self.gpu_slices = gpu_slices
         self.round = 0
         self.dead: set[int] = set()
         self.allocated = n_targets  # grows via add_targets
@@ -456,25 +465,39 @@ class SynthTargetFarm:
     def pod_of(self, idx: int) -> str:
         return f"job-{(idx + self.pod_gen) % 31}"
 
+    def family_of_slice(self, sl: int) -> str:
+        return "gpu" if sl >= self.n_slices - self.gpu_slices else "tpu"
+
+    def family_of(self, idx: int) -> str:
+        return self.family_of_slice(idx % self.n_slices)
+
     def tick(self) -> None:
         self.round += 1
 
     def body(self, idx: int) -> str:
         """Deterministic exposition for one target at the current round.
         Shapes every family the aggregator tier folds: per-chip presence/
-        HBM/duty/ICI, host identity with a multislice group, pod rollups."""
+        HBM(-or-GPU-memory)/duty(-or-utilization)/ICI, host identity with
+        a multislice group, pod rollups. GPU-slice targets publish the
+        gpu_* node surface — no ICI (GPUs serve none here) and no
+        multislice group (a TPU-fabric concept)."""
         r = self.round
         sl = idx % self.n_slices
+        gpu = self.family_of_slice(sl) == "gpu"
         host = f"host-{idx:04d}"
+        accel = "a100-sim" if gpu else "v5p-sim"
         base = (
-            f'accelerator="v5p-sim",slice_name="slice-{sl}",host="{host}",'
+            f'accelerator="{accel}",slice_name="slice-{sl}",host="{host}",'
             f'worker_id="{idx}"'
         )
         pod = self.pod_of(idx)
         hot = idx in self.hot
         lines: list[str] = []
-        hbm_total = float(96 * 2**30)
+        hbm_total = float((80 if gpu else 96) * 2**30)
         pod_hbm = 0.0
+        p = "gpu" if gpu else "tpu"
+        duty_name = ("gpu_utilization_percent" if gpu
+                     else "tpu_tensorcore_duty_cycle_percent")
         for c in range(self.chips):
             cl = (f'chip_id="{c}",device_path="",{base},pod="{pod}",'
                   f'namespace="sim",container="worker"')
@@ -488,23 +511,34 @@ class SynthTargetFarm:
             duty = float((idx * 7 + c * 13 + r) % 100)
             if hot:
                 duty = 90.0 + float((idx * 7 + c * 13 + r) % 10)
-            lines.append(f'tpu_chip_info{{{cl},device_kind="",coords=""}} 1')
-            lines.append(f'tpu_hbm_used_bytes{{{cl}}} {hbm:.1f}')
-            lines.append(f'tpu_hbm_total_bytes{{{cl}}} {hbm_total:.1f}')
+            kind = 'device_kind="A100-sim"' if gpu else 'device_kind=""'
+            lines.append(f'{p}_chip_info{{{cl},{kind},coords=""}} 1')
+            lines.append(f'{p}_hbm_used_bytes{{{cl}}} {hbm:.1f}')
+            lines.append(f'{p}_hbm_total_bytes{{{cl}}} {hbm_total:.1f}')
+            lines.append(f'{duty_name}{{{cl}}} {duty:.1f}')
+            if not gpu:
+                lines.append(
+                    f'tpu_ici_link_bandwidth_bytes_per_second{{{cl},link="0"}} '
+                    f'{float((idx + r) % 7) * 1e6:.1f}')
+        if gpu:
             lines.append(
-                f'tpu_tensorcore_duty_cycle_percent{{{cl}}} {duty:.1f}')
+                f'tpu_host_info{{{base},multislice_group="",num_slices=""}} 1')
             lines.append(
-                f'tpu_ici_link_bandwidth_bytes_per_second{{{cl},link="0"}} '
-                f'{float((idx + r) % 7) * 1e6:.1f}')
-        lines.append(
-            f'tpu_host_info{{{base},multislice_group="ms-{sl % 2}",'
-            f'num_slices="{(self.n_slices + 1) // 2}"}} 1')
-        lines.append(
-            f'tpu_pod_chip_count{{pod="{pod}",namespace="sim",{base}}} '
-            f'{self.chips}')
-        lines.append(
-            f'tpu_pod_hbm_used_bytes{{pod="{pod}",namespace="sim",{base}}} '
-            f'{pod_hbm:.1f}')
+                f'gpu_pod_chip_count{{pod="{pod}",namespace="sim",{base}}} '
+                f'{self.chips}')
+            lines.append(
+                f'gpu_pod_memory_used_bytes{{pod="{pod}",namespace="sim",'
+                f'{base}}} {pod_hbm:.1f}')
+        else:
+            lines.append(
+                f'tpu_host_info{{{base},multislice_group="ms-{sl % 2}",'
+                f'num_slices="{(self.n_slices - self.gpu_slices + 1) // 2}"}} 1')
+            lines.append(
+                f'tpu_pod_chip_count{{pod="{pod}",namespace="sim",{base}}} '
+                f'{self.chips}')
+            lines.append(
+                f'tpu_pod_hbm_used_bytes{{pod="{pod}",namespace="sim",{base}}} '
+                f'{pod_hbm:.1f}')
         return "\n".join(lines) + "\n"
 
     def api_body(self, idx: int, route: str, query: str) -> str:
@@ -685,7 +719,7 @@ class _ShardSim:
                  root_breaker_backoff_s: float = 10.0,
                  root_breaker_backoff_max_s: float = 120.0,
                  n_slices: int = 8, query_plane: bool = False,
-                 store_factory=None) -> None:
+                 store_factory=None, gpu_slices: int = 0) -> None:
         import os
 
         from tpu_pod_exporter.aggregate import SliceAggregator, default_fetch
@@ -702,7 +736,8 @@ class _ShardSim:
         self.timeout_s = timeout_s
         self.net = net
         self.farm = SynthTargetFarm(n_targets, chips=chips,
-                                    n_slices=n_slices)
+                                    n_slices=n_slices,
+                                    gpu_slices=gpu_slices)
         self.targets_file = os.path.join(state_root, "targets.txt")
         self.write_targets(self.farm.targets())
         self.smap = ShardMap(default_shards(shards))
@@ -904,6 +939,10 @@ class _ShardSim:
 # Rollup families the oracle comparison covers — everything emit_rollups
 # produces plus the per-target passthrough both tiers publish.
 _ORACLE_FAMILIES = (
+    "tpu_fleet_family_hosts_reporting",
+    "tpu_fleet_family_chip_count",
+    "tpu_fleet_family_hbm_used_bytes",
+    "tpu_fleet_family_hbm_total_bytes",
     "tpu_slice_hosts_reporting",
     "tpu_slice_chip_count",
     "tpu_slice_hbm_used_bytes",
@@ -961,7 +1000,7 @@ def _compare_oracle(root_map: dict, oracle_map: dict) -> list[str]:
 
 def run_shard_demo(n_targets: int, shards: int, ha: bool, chips: int,
                    churn: int, round_budget_s: float, stale_budget_s: float,
-                   state_root: str) -> dict:
+                   state_root: str, gpu_slices: int = 2) -> dict:
     """The sharded-tree acceptance scenario (``make shard-demo``):
 
     1. prime the tree; two permanently-dead targets teach the owning
@@ -986,12 +1025,16 @@ def run_shard_demo(n_targets: int, shards: int, ha: bool, chips: int,
 
     result: dict = {
         "ok": False, "targets": n_targets, "shards": shards, "ha": ha,
-        "chips": chips,
+        "chips": chips, "gpu_slices": gpu_slices,
     }
     if not ha:
         result["error"] = "shard demo needs --ha (the failover is the point)"
         return result
-    sim = _ShardSim(n_targets, shards, ha, chips, state_root)
+    # Mixed fleet by default (gpu_slices of the farm's 8 slices are GPU
+    # node pools): both device families ride one tree, and the oracle
+    # comparison below covers the per-family rollups too.
+    sim = _ShardSim(n_targets, shards, ha, chips, state_root,
+                    gpu_slices=gpu_slices)
     timings: list[dict] = []
     try:
         # Two permanently-dead targets (and their leaf quarantines).
@@ -1028,6 +1071,30 @@ def run_shard_demo(n_targets: int, shards: int, ha: bool, chips: int,
             return result
         result["baseline"] = {"rollup_series": len(root_map),
                               "oracle_equal": True}
+        # Per-family rollups against the arithmetic ground truth: every
+        # live target contributes `chips` chips to exactly its own
+        # family's fleet count — mixed sums that crossed families would
+        # land on the right total while being family-wrong, so the split
+        # is checked against first principles, not just the oracle.
+        fam_expected: dict[str, float] = {}
+        for i in range(sim.farm.allocated):
+            if i not in sim.farm.dead:
+                fam = sim.farm.family_of(i)
+                fam_expected[fam] = fam_expected.get(fam, 0.0) + chips
+        fam_reported = {
+            s.labels["family"]: s.value
+            for s in parse_families(sim.root_body()).get(
+                "tpu_fleet_family_chip_count", ())
+        }
+        result["baseline"]["family_chips"] = fam_reported
+        if fam_reported != fam_expected:
+            result["error"] = (
+                f"per-family fleet chips {fam_reported} != expected "
+                f"{fam_expected} (family-correctness violated)")
+            return result
+        if gpu_slices > 0 and "gpu" not in fam_reported:
+            result["error"] = "mixed demo reported no GPU family chips"
+            return result
         baseline_series = set(root_map)
         quarantined = [
             t for t, br in (sim.leaves[victim].agg.breakers or {}).items()
@@ -1230,6 +1297,10 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--state-root", default="shard-demo-state",
                    help="[shard] state dir (breaker/shard-map carryover; "
                         "uploaded as a CI artifact on failure)")
+    p.add_argument("--gpu-slices", type=int, default=2,
+                   help="[shard] farm slices (of 8) that are GPU node "
+                        "pools — the mixed-fleet half of the demo; 0 for "
+                        "a homogeneous TPU farm")
     p.add_argument("--targets", type=int, default=64)
     p.add_argument("--chips", type=int, default=4, help="chips per host")
     p.add_argument("--polls", type=int, default=10,
@@ -1251,6 +1322,7 @@ def main(argv: list[str] | None = None) -> int:
         result = run_shard_demo(
             ns.targets, ns.shards, ns.ha, ns.chips, ns.churn,
             ns.round_budget_s, ns.stale_budget_s, ns.state_root,
+            gpu_slices=ns.gpu_slices,
         )
         print(json.dumps(result, indent=1))
         try:
@@ -1268,7 +1340,8 @@ def main(argv: list[str] | None = None) -> int:
         t = result["timings"]
         print(
             f"shard-demo OK: {ns.targets} targets / {ns.shards} shards "
-            f"(HA={'on' if ns.ha else 'off'}), mid-round leaf kill → "
+            f"(HA={'on' if ns.ha else 'off'}, families "
+            f"{result['baseline']['family_chips']}), mid-round leaf kill → "
             f"0 series lost, churn {ns.churn} → "
             f"{result['churn']['assignment_moves']} moves "
             f"(bound {result['churn']['bound']}), round max "

@@ -131,6 +131,7 @@ class _Run:
             root_breaker_backoff_s=0.4, root_breaker_backoff_max_s=0.8,
             n_slices=4, query_plane=True,
             store_factory=self._make_store if self.store_on else None,
+            gpu_slices=scn.gpu_slices,
         )
         self.membership: list[str] = list(self.sim.farm.targets())
         # Root /readyz over real HTTP: partition-aware degradation is an
@@ -253,6 +254,12 @@ class _Run:
         # bounded by the settle loop, not an instant flip.
         self.recovering_leaves: set[str] = set()
         self.restart_batches: dict[int, tuple[int, ...]] = {}
+        # mixed_wedge parity bookkeeping: per-wedge degradation signature
+        # ({family, victims, down, chip drop, other-family drift,
+        # quarantined}) captured at each preempt window's last round; the
+        # finish asserts the TPU and GPU signatures are identical in kind.
+        self.wedge_sigs: list[dict] = []
+        self._wedge_chips_before: dict[str, float] = {}
         # store_continuity boundary stamps (root_restart event hooks).
         self.start_wall = 0.0
         self.kill_wall = 0.0
@@ -270,7 +277,11 @@ class _Run:
 
         rules = parse_rules(
             "scenario:hbm:by_slice = sum("
-            + schema.TPU_SLICE_HBM_USED_BYTES.name + ") by (slice_name)\n")
+            + schema.TPU_SLICE_HBM_USED_BYTES.name + ") by (slice_name)\n"
+            # Per-family aggregation through the rule plane: mixed fleets
+            # precompute the family split the same way the drills read it.
+            "scenario:chips:by_family = sum("
+            + schema.TPU_SLICE_CHIP_COUNT.name + ") by (family)\n")
         s = FleetStore(self.store_dir, tiers=self.STORE_TIERS, rules=rules)
         s.open()
         # Hooks and held rung state live on the instance: a restart-
@@ -398,6 +409,14 @@ class _Run:
             victims = [i for i in farm.slice_targets(sl)
                        if i in self._member_indices()]
             ev_state = set(victims)
+            # Pre-wedge family chip counts, from the root's CURRENT body
+            # (last round's publish — the wedge has not bitten yet): the
+            # per-family drop baseline for the mixed-wedge parity check.
+            self._wedge_chips_before = {
+                s.labels.get("family", "?"): s.value
+                for s in parse_families(self.sim.root_body()).get(
+                    schema.TPU_FLEET_FAMILY_CHIP_COUNT.name, ())
+            }
             farm.dead |= ev_state
             self._preempt_victims = ev_state
         elif ev.kind == "hotspot":
@@ -809,6 +828,40 @@ class _Run:
         for ev in active:
             if ev.end_round - 1 != r:
                 continue
+            if ev.kind == "preempt" and self.scn.gpu_slices:
+                # mixed_wedge parity: capture this wedge's degradation
+                # signature at its last injected round (breakers have had
+                # the whole window to open). Asserted pairwise at finish.
+                sl = int(ev.subject.rsplit("-", 1)[1])
+                fam = farm.family_of_slice(sl)
+                other = "gpu" if fam == "tpu" else "tpu"
+                victims = getattr(self, "_preempt_victims", set())
+                fam_chips = {
+                    s.labels.get("family", "?"): s.value
+                    for s in fams.get(
+                        schema.TPU_FLEET_FAMILY_CHIP_COUNT.name, ())
+                }
+                quarantined = sum(
+                    s.value for s in fams.get(
+                        schema.TPU_ROOT_SHARD_QUARANTINED_TARGETS.name, ())
+                )
+                before = self._wedge_chips_before
+                self.wedge_sigs.append({
+                    "family": fam,
+                    "slice": ev.subject,
+                    "victims": len(victims),
+                    "victims_down": sum(
+                        1 for i in victims
+                        if target_up.get(farm.url(i)) == 0.0
+                    ),
+                    "chips_dropped": (
+                        before.get(fam, 0.0) - fam_chips.get(fam, 0.0)
+                    ),
+                    "other_family_drift": (
+                        before.get(other, 0.0) - fam_chips.get(other, 0.0)
+                    ),
+                    "quarantined": quarantined,
+                })
             if ev.kind == "disk_full":
                 usage = dir_usage_bytes(self.egress_dir)
                 # Post-shed floor: compaction's steady state is one shed
@@ -981,6 +1034,62 @@ class _Run:
 
         if self.scn.name == "store_continuity":
             self._check_store_continuity()
+
+        if self.scn.name == "mixed_wedge":
+            # The GPU parity verdict: a wedged GPU node pool must degrade
+            # IDENTICALLY to a wedged TPU node pool — same victim
+            # accounting, same breaker quarantine, same family-correct
+            # chip drop, zero drift on the untouched family. (Zero
+            # acked-sample loss rides the standard egress ledger check
+            # below.)
+            result["wedges"] = self.wedge_sigs
+            by_family = {sig["family"]: sig for sig in self.wedge_sigs}
+            if set(by_family) != {"tpu", "gpu"}:
+                self.problems.append(
+                    f"mixed_wedge recorded wedges for {sorted(by_family)}, "
+                    f"want one TPU and one GPU")
+            else:
+                t, g = by_family["tpu"], by_family["gpu"]
+                for sig in (t, g):
+                    if sig["victims"] == 0:
+                        self.problems.append(
+                            f"mixed_wedge: {sig['family']} wedge had no "
+                            f"victims (slice {sig['slice']} empty?)")
+                    if sig["victims_down"] != sig["victims"]:
+                        self.problems.append(
+                            f"mixed_wedge: {sig['family']} wedge dropped "
+                            f"up for {sig['victims_down']}/{sig['victims']} "
+                            f"victims")
+                    if sig["quarantined"] < 1:
+                        self.problems.append(
+                            f"mixed_wedge: {sig['family']} wedge opened no "
+                            f"leaf breakers (quarantine semantics differ)")
+                    if sig["other_family_drift"] > 0.0:
+                        # Positive drift only: the violation is the OTHER
+                        # family LOSING chips to this wedge. Negative
+                        # drift is the other family still re-admitting its
+                        # own earlier wedge's victims at window start
+                        # (breaker half-open probes lag the heal) — that
+                        # is recovery, not cross-family leakage.
+                        self.problems.append(
+                            f"mixed_wedge: {sig['family']} wedge dropped the "
+                            f"OTHER family's chip count by "
+                            f"{sig['other_family_drift']:g} (family sums "
+                            f"not family-correct)")
+                if t["victims"] == g["victims"] and (
+                        t["chips_dropped"] != g["chips_dropped"]):
+                    self.problems.append(
+                        f"mixed_wedge: equal victim counts but unequal "
+                        f"chip drops (tpu {t['chips_dropped']:g} vs gpu "
+                        f"{g['chips_dropped']:g}) — degradation not "
+                        f"identical")
+                chips = self.sim.farm.chips
+                for sig in (t, g):
+                    if sig["chips_dropped"] != sig["victims"] * chips:
+                        self.problems.append(
+                            f"mixed_wedge: {sig['family']} chip drop "
+                            f"{sig['chips_dropped']:g} != victims x chips "
+                            f"({sig['victims']} x {chips})")
 
         # /readyz healthy again, over the wire.
         doc = _get_json(f"http://127.0.0.1:{self.root_server.port}/readyz")

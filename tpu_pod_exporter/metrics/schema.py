@@ -151,6 +151,68 @@ TPU_CHIP_PROCESS_INFO = MetricSpec(
     label_names=PROCESS_LABELS,
 )
 
+# --- GPU device family (backend/nvml.py) -------------------------------------
+# Twins of the node surface for the second device family: the NVML-shaped
+# backend publishes per-chip series under gpu_* instead of tpu_*, keyed by
+# ChipInfo.family — a mixed GPU/TPU fleet must never sum across families.
+# Same label schema as the TPU twins (chip_id is the NVML device index,
+# main.go:123-124; device_kind carries DeviceGetName). Conditional surface:
+# declared only on exporters whose backend (or any observed chip) is
+# GPU-family, the same rule as TPU_CHIP_PROCESS_INFO.
+
+GPU_HBM_USED_BYTES = MetricSpec(
+    name="gpu_hbm_used_bytes",
+    help="Device memory in use on this GPU, in bytes (NVML GetMemoryInfo.used).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+GPU_HBM_TOTAL_BYTES = MetricSpec(
+    name="gpu_hbm_total_bytes",
+    help="Total device memory capacity of this GPU, in bytes (NVML GetMemoryInfo.total).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+GPU_HBM_USED_PERCENT = MetricSpec(
+    name="gpu_hbm_used_percent",
+    help="Percent of this GPU's device memory currently in use (0-100) — the per-chip analog of the reference's docker_gpu_memory_perc_usage (main.go:149-150).",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+GPU_UTILIZATION_PERCENT = MetricSpec(
+    name="gpu_utilization_percent",
+    help="GPU compute-unit utilization over the last sample window (0-100, NVML GetUtilizationRates.gpu) — the GPU twin of tpu_tensorcore_duty_cycle_percent. Absent on boards whose driver reports NOT_SUPPORTED.",
+    type=GAUGE,
+    label_names=CHIP_LABELS,
+)
+
+GPU_CHIP_INFO = MetricSpec(
+    name="gpu_chip_info",
+    help="Static GPU identity; value is always 1. device_kind is the NVML marketing name. The guaranteed per-chip presence series GPU slice rollups count chips from — same contract as tpu_chip_info.",
+    type=GAUGE,
+    label_names=CHIP_LABELS + ("device_kind", "coords"),
+)
+
+# The reference's headline dimension, honest on GPU: NVML reports true
+# per-process device memory (main.go:135,147), so unlike the TPU path this
+# is the runtime's own table, not a procfs holder scan. pod/namespace/
+# container labels come from the same podresources device-ID join as every
+# other chip series.
+GPU_PROCESS_MEMORY_USED_BYTES = MetricSpec(
+    name="gpu_process_memory_used_bytes",
+    help="Device memory used by one process on this GPU, in bytes (NVML GetComputeRunningProcesses, main.go:134-155); pod attribution via the kubelet podresources join.",
+    type=GAUGE,
+    label_names=CHIP_LABELS + ("pid", "comm"),
+)
+
+GPU_BACKEND_UP = MetricSpec(
+    name="gpu_backend_up",
+    help="1 if the most recent poll read the GPU backend without fatal error, else 0 — the per-backend up twin of the device half of tpu_exporter_up, so mixed-fleet dashboards can alert per family.",
+    type=GAUGE,
+)
+
 # --- Pod-level rollups -------------------------------------------------------
 
 POD_LABELS: tuple[str, ...] = ("pod", "namespace", "accelerator", "slice_name", "host", "worker_id")
@@ -167,6 +229,38 @@ TPU_POD_HBM_USED_BYTES = MetricSpec(
     help="Sum of HBM bytes in use across all chips allocated to this pod on this host.",
     type=GAUGE,
     label_names=POD_LABELS,
+)
+
+# GPU twins of the pod rollups — the paper's headline metric
+# (pod_gpu_memory_usage, main.go:21-28) with the label-schema defects
+# fixed: namespace/host/slice labels, chip counts, and device memory from
+# the podresources join instead of the broken container-PID scan.
+GPU_POD_CHIP_COUNT = MetricSpec(
+    name="gpu_pod_chip_count",
+    help="Number of GPUs currently allocated to this pod on this host.",
+    type=GAUGE,
+    label_names=POD_LABELS,
+)
+
+GPU_POD_MEMORY_USED_BYTES = MetricSpec(
+    name="gpu_pod_memory_used_bytes",
+    help="Sum of device-memory bytes in use across all GPUs allocated to this pod on this host — the per-pod GPU memory headline (main.go:24,147), via the same kubelet device-ID join the TPU path uses.",
+    type=GAUGE,
+    label_names=POD_LABELS,
+)
+
+# The conditional GPU node surface, declared as a block once the exporter
+# is (or observes) the GPU family — stable from that poll on.
+GPU_NODE_SPECS: tuple[MetricSpec, ...] = (
+    GPU_HBM_USED_BYTES,
+    GPU_HBM_TOTAL_BYTES,
+    GPU_HBM_USED_PERCENT,
+    GPU_UTILIZATION_PERCENT,
+    GPU_CHIP_INFO,
+    GPU_PROCESS_MEMORY_USED_BYTES,
+    GPU_POD_CHIP_COUNT,
+    GPU_POD_MEMORY_USED_BYTES,
+    GPU_BACKEND_UP,
 )
 
 # --- Kubelet inventory (podresources GetAllocatableResources) ----------------
@@ -698,7 +792,11 @@ ALL_SPECS: tuple[MetricSpec, ...] = (
 # (SURVEY.md §2.8); the aggregator computes the same label joins for setups
 # without one, scraping each host's /metrics and re-exporting slice sums.
 
-SLICE_LABELS: tuple[str, ...] = ("slice_name", "accelerator")
+# family is the accelerator-family rollup key ("tpu" | "gpu"): slices are
+# homogeneous (a GKE node pool is one device family), but the label rides
+# every slice rollup so fleet-level sums can stay family-correct and the
+# FleetStore's recording rules can aggregate `by (family)`.
+SLICE_LABELS: tuple[str, ...] = ("slice_name", "accelerator", "family")
 
 TPU_SLICE_HOSTS_REPORTING = MetricSpec(
     name="tpu_slice_hosts_reporting",
@@ -754,6 +852,49 @@ TPU_SLICE_DCN_BYTES_PER_SECOND = MetricSpec(
     help="Sum of per-link DCN (cross-slice network) traffic rates across the slice.",
     type=GAUGE,
     label_names=SLICE_LABELS,
+)
+
+# --- Per-family fleet rollups -------------------------------------------------
+# Sums of the slice rollups grouped by accelerator family, emitted through
+# the same emit_rollups path at every tier (flat aggregator, sharded root):
+# the "how much GPU vs TPU is this fleet running" headline, and the series
+# the mixed-fleet drills assert against a flat per-family oracle.
+
+FAMILY_LABELS: tuple[str, ...] = ("family",)
+
+TPU_FLEET_FAMILY_HOSTS_REPORTING = MetricSpec(
+    name="tpu_fleet_family_hosts_reporting",
+    help="Hosts contributing chip samples this round, per accelerator family (tpu/gpu).",
+    type=GAUGE,
+    label_names=FAMILY_LABELS,
+)
+
+TPU_FLEET_FAMILY_CHIP_COUNT = MetricSpec(
+    name="tpu_fleet_family_chip_count",
+    help="Chips reporting across all scraped slices of this accelerator family — mixed fleets must never sum chips across families, so the split is published, not derived.",
+    type=GAUGE,
+    label_names=FAMILY_LABELS,
+)
+
+TPU_FLEET_FAMILY_HBM_USED_BYTES = MetricSpec(
+    name="tpu_fleet_family_hbm_used_bytes",
+    help="Device-memory bytes in use across all chips of this accelerator family (absent until at least one chip of the family reports memory).",
+    type=GAUGE,
+    label_names=FAMILY_LABELS,
+)
+
+TPU_FLEET_FAMILY_HBM_TOTAL_BYTES = MetricSpec(
+    name="tpu_fleet_family_hbm_total_bytes",
+    help="Device-memory capacity across all chips of this accelerator family (absent until at least one chip of the family reports capacity).",
+    type=GAUGE,
+    label_names=FAMILY_LABELS,
+)
+
+FAMILY_SPECS: tuple[MetricSpec, ...] = (
+    TPU_FLEET_FAMILY_HOSTS_REPORTING,
+    TPU_FLEET_FAMILY_CHIP_COUNT,
+    TPU_FLEET_FAMILY_HBM_USED_BYTES,
+    TPU_FLEET_FAMILY_HBM_TOTAL_BYTES,
 )
 
 # Cross-SLICE (multi-slice group) rollups. Joined via tpu_host_info's
@@ -1016,9 +1157,9 @@ TPU_LEAF_WORKLOAD_COMPONENT = MetricSpec(
 
 TPU_LEAF_SLICE_GROUP_INFO = MetricSpec(
     name="tpu_leaf_slice_group_info",
-    help="Multi-slice membership observed by this leaf (slice -> group join key, from tpu_host_info); value is always 1. The root rebuilds multislice rollups fleet-wide from these.",
+    help="Multi-slice membership observed by this leaf (slice -> group join key, from tpu_host_info); value is always 1. The root rebuilds multislice rollups fleet-wide from these. No family label: membership comes from tpu_host_info, which carries none (multi-slice is a TPU-fabric concept).",
     type=GAUGE,
-    label_names=SLICE_LABELS + ("multislice_group", "num_slices"),
+    label_names=("slice_name", "accelerator", "multislice_group", "num_slices"),
 )
 
 TPU_LEAF_SHARD_INFO = MetricSpec(
@@ -1078,6 +1219,13 @@ TPU_ROOT_SHARD_QUARANTINED_TARGETS = MetricSpec(
     label_names=("shard",),
 )
 
+TPU_ROOT_SHARD_FAMILY_CHIPS = MetricSpec(
+    name="tpu_root_shard_family_chips",
+    help="Chips this shard's freshest merged view reports, per accelerator family — consistent hashing mixes node pools across shards, so the per-shard family split (status --tree's family column) is published here.",
+    type=GAUGE,
+    label_names=("shard", "family"),
+)
+
 TPU_ROOT_LEAF_STALE_SERVED = MetricSpec(
     name="tpu_root_leaf_stale_served",
     help="1 while the root is merging this leaf's LAST-KNOWN view because the leaf is currently unreachable (within --stale-serve-s). The fleet view stays populated through a root-leaf network partition — stale-but-labeled, never vanished; tpu_root_leaf_staleness_seconds says how stale.",
@@ -1129,6 +1277,7 @@ ROOT_SPECS: tuple[MetricSpec, ...] = (
     TPU_ROOT_LEAF_PARTITION_SUSPECTED,
     TPU_ROOT_SHARD_TARGETS,
     TPU_ROOT_SHARD_QUARANTINED_TARGETS,
+    TPU_ROOT_SHARD_FAMILY_CHIPS,
     TPU_ROOT_DEDUP_STALE_WINS_TOTAL,
     TPU_ROOT_RESHARD_MOVES_TOTAL,
     TPU_ROOT_LAST_ROUND_TIMESTAMP_SECONDS,
@@ -1237,6 +1386,10 @@ STORE_SPECS: tuple[MetricSpec, ...] = (
 # per-target up — the "what is the fleet doing" set a central TSDB wants,
 # not the aggregator's own plumbing counters.
 AGGREGATE_EGRESS_SPECS: tuple[MetricSpec, ...] = (
+    TPU_FLEET_FAMILY_HOSTS_REPORTING,
+    TPU_FLEET_FAMILY_CHIP_COUNT,
+    TPU_FLEET_FAMILY_HBM_USED_BYTES,
+    TPU_FLEET_FAMILY_HBM_TOTAL_BYTES,
     TPU_SLICE_HOSTS_REPORTING,
     TPU_SLICE_CHIP_COUNT,
     TPU_SLICE_HBM_USED_BYTES,
@@ -1274,6 +1427,10 @@ AGGREGATE_SPECS: tuple[MetricSpec, ...] = (
     TPU_MULTISLICE_HBM_USED_BYTES,
     TPU_MULTISLICE_ICI_BYTES_PER_SECOND,
     TPU_MULTISLICE_DCN_BYTES_PER_SECOND,
+    TPU_FLEET_FAMILY_HOSTS_REPORTING,
+    TPU_FLEET_FAMILY_CHIP_COUNT,
+    TPU_FLEET_FAMILY_HBM_USED_BYTES,
+    TPU_FLEET_FAMILY_HBM_TOTAL_BYTES,
     TPU_WORKLOAD_CHIP_COUNT,
     TPU_WORKLOAD_HBM_USED_BYTES,
     TPU_WORKLOAD_HOSTS,

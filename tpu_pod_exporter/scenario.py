@@ -353,6 +353,10 @@ class Scenario:
     # subsecond rounds would cram every pre-kill sample into one open
     # bucket and make the (correct) continuity invariant flaky.
     round_pause_s: float = 0.0
+    # Mixed-fleet drills: the engine farm's LAST gpu_slices slices become
+    # GPU node pools (gpu_* node surface, family="gpu" rollups). 0 keeps
+    # the farm homogeneous — every pre-GPU drill runs byte-identically.
+    gpu_slices: int = 0
 
     def events(self) -> list[ScenarioEvent]:
         return parse_scenario(self.timeline)
@@ -492,6 +496,23 @@ SCENARIOS: dict[str, Scenario] = {
             # One finest store bucket (engine tiers: 0.25 s) must
             # finalize per pre-kill round — see round_pause_s above.
             round_pause_s=0.35,
+        ),
+        Scenario(
+            name="mixed_wedge",
+            timeline="preempt(slice-1)@3+3; preempt(slice-2)@10+3",
+            description=(
+                "The GPU parity drill (mixed TPU+GPU tree, 2 of 4 slices "
+                "GPU): wedge one whole TPU slice, settle, then wedge one "
+                "whole GPU slice the same way. Both wedges must degrade "
+                "IDENTICALLY — target_up drops for exactly the victims, "
+                "leaf breakers quarantine them, the wedged family's fleet "
+                "chip count drops by exactly the victims' chips while the "
+                "OTHER family's sums hold steady — and the egress ledger "
+                "stays exactly-once through both windows. slice-1 is TPU, "
+                "slice-2 GPU (the farm's last gpu_slices slices)."
+            ),
+            settle_rounds=4,
+            gpu_slices=2,
         ),
         Scenario(
             name="recv_outage",

@@ -464,7 +464,8 @@ class LeafView:
 
     leaf: str
     round_ts: float = 0.0
-    slice_fields: dict[tuple[str, str], dict[str, float]] = field(
+    # (slice_name, accelerator, family) -> field map
+    slice_fields: dict[tuple[str, str, str], dict[str, float]] = field(
         default_factory=dict)
     workload_fields: dict[tuple[str, str, str], dict[str, float]] = field(
         default_factory=dict)
@@ -485,7 +486,10 @@ def fold_leaf_body(leaf: str, samples: Iterable[tuple]) -> LeafView:
             fname = labels.get("field", "")
             if fname not in schema.LEAF_SLICE_FIELDS:
                 continue  # newer leaf: unknown components are ignored
-            key = (labels.get("slice_name", ""), labels.get("accelerator", ""))
+            # family defaults to "tpu" so a pre-GPU leaf's components
+            # merge unchanged (missing label = the only family there was).
+            key = (labels.get("slice_name", ""), labels.get("accelerator", ""),
+                   labels.get("family", "tpu"))
             view.slice_fields.setdefault(key, {})[fname] = value
         elif name == schema.TPU_LEAF_WORKLOAD_COMPONENT.name:
             fname = labels.get("field", "")
@@ -526,7 +530,8 @@ class ShardMerged:
     """One shard after HA dedup: per-series-group winners plus dedup
     bookkeeping."""
 
-    slices: dict[tuple[str, str], SliceStats] = field(default_factory=dict)
+    slices: dict[tuple[str, str, str], SliceStats] = field(
+        default_factory=dict)
     workloads: dict[tuple[str, str, str], WorkloadStats] = field(
         default_factory=dict)
     group_info: dict[tuple[str, str], tuple[str, str]] = field(
@@ -958,7 +963,7 @@ class RootAggregator:
             b.declare(spec)
 
         # Fleet fold: sum per-shard accumulators, then the ONE emit path.
-        fleet_slices: dict[tuple[str, str], SliceStats] = {}
+        fleet_slices: dict[tuple[str, str, str], SliceStats] = {}
         fleet_workloads: dict[tuple[str, str, str], WorkloadStats] = {}
         fleet_groups: dict[tuple[str, str], tuple[str, str]] = {}
         target_up: dict[str, tuple[float, float]] = {}
@@ -1020,6 +1025,16 @@ class RootAggregator:
             )
             b.add(schema.TPU_ROOT_SHARD_QUARANTINED_TARGETS,
                   float(quarantined), (shard,))
+            # Per-shard accelerator-family split (status --tree's family
+            # column): consistent hashing mixes node pools across shards,
+            # so which families a shard carries is data, not topology.
+            shard_fams: dict[str, float] = {}
+            for key, stats in sm.slices.items():
+                fam = key[2] if len(key) > 2 else "tpu"
+                shard_fams[fam] = shard_fams.get(fam, 0.0) + stats.chips
+            for fam, chips in sorted(shard_fams.items()):
+                b.add(schema.TPU_ROOT_SHARD_FAMILY_CHIPS, chips,
+                      (shard, fam))
         for spec in (schema.TPU_ROOT_DEDUP_STALE_WINS_TOTAL,
                      schema.TPU_ROOT_RESHARD_MOVES_TOTAL):
             for lv, v in self._counters.items_for(spec.name):

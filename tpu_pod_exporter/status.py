@@ -94,7 +94,15 @@ def main(argv=None) -> int:
         host=cfg.node_name, worker_id=cfg.worker_id,
     )
     backend = build_backend(cfg)
-    attribution = build_attribution(cfg)
+    # Same family→resource dispatch as ExporterApp: the doctor must join
+    # attribution the way the exporter it diagnoses would (nvidia.com/gpu
+    # device UUIDs for GPU-family backends).
+    resource_name = (
+        cfg.gpu_resource_name
+        if getattr(backend, "family", "tpu") == "gpu"
+        else cfg.resource_name
+    )
+    attribution = build_attribution(cfg, resource_name)
     scanner = None
     if cfg.process_metrics:
         from tpu_pod_exporter.procscan import ProcScanner
@@ -257,7 +265,8 @@ def fetch_tree(addr: str, timeout_s: float = 5.0) -> dict:
         shard = s.labels.get("shard", "?")
         leaf = s.labels.get("leaf", "?")
         entry = shards.setdefault(
-            shard, {"targets": None, "quarantined": None, "leaves": {}})
+            shard, {"targets": None, "quarantined": None, "leaves": {},
+                    "families": {}})
         entry["leaves"][leaf] = {"up": s.value, "staleness_s": None}
     for s in fams.get(schema.TPU_ROOT_LEAF_STALENESS_SECONDS.name, ()):
         shard = s.labels.get("shard", "?")
@@ -273,6 +282,11 @@ def fetch_tree(addr: str, timeout_s: float = 5.0) -> dict:
         entry = shards.get(s.labels.get("shard", "?"))
         if entry is not None:
             entry["quarantined"] = s.value
+    for s in fams.get(schema.TPU_ROOT_SHARD_FAMILY_CHIPS.name, ()):
+        entry = shards.get(s.labels.get("shard", "?"))
+        if entry is not None:
+            entry.setdefault("families", {})[
+                s.labels.get("family", "?")] = s.value
     for entry in shards.values():
         fresh = None
         for leaf, doc in entry["leaves"].items():
@@ -285,6 +299,17 @@ def fetch_tree(addr: str, timeout_s: float = 5.0) -> dict:
         1 for s in fams.get(schema.TPU_AGG_TARGET_UP.name, ())
         if s.value == 1.0
     )
+    # Per-family chip/memory split for the fleet footer — read from the
+    # published tpu_fleet_family_* rollups, never re-derived by summing
+    # (the whole point of publishing the split).
+    family_chips = {
+        s.labels.get("family", "?"): s.value
+        for s in fams.get(schema.TPU_FLEET_FAMILY_CHIP_COUNT.name, ())
+    }
+    family_hbm = {
+        s.labels.get("family", "?"): s.value
+        for s in fams.get(schema.TPU_FLEET_FAMILY_HBM_USED_BYTES.name, ())
+    }
     return {
         "root": addr,
         "shards": shards,
@@ -294,6 +319,8 @@ def fetch_tree(addr: str, timeout_s: float = 5.0) -> dict:
             "chips": sum(
                 s.value for s in fams.get(schema.TPU_SLICE_CHIP_COUNT.name,
                                           ())),
+            "family_chips": family_chips,
+            "family_hbm_used_bytes": family_hbm,
             "dedup_stale_wins_total": first_value(
                 schema.TPU_ROOT_DEDUP_STALE_WINS_TOTAL.name),
             "reshard_moves_total": first_value(
@@ -323,21 +350,44 @@ def render_tree(doc: dict) -> str:
                 leaf_cells.append(f"{leaf} DOWN")
         t = entry.get("targets")
         q = entry.get("quarantined")
+        fams_cell = "-"
+        families = entry.get("families") or {}
+        if families:
+            # e.g. "tpu:48+gpu:16" — which device families this shard's
+            # consistent-hash cut happens to carry, and how many chips.
+            fams_cell = "+".join(
+                f"{fam}:{chips:g}"
+                for fam, chips in sorted(families.items())
+            )
         rows.append([
             shard,
             int(t) if t is not None else "-",
             int(q) if q is not None else "-",
+            fams_cell,
             ", ".join(leaf_cells) or "-",
         ])
     out = []
     if rows:
         out.append(render_table(
-            rows, ["shard", "targets", "quar", "leaves (* = freshest)"]))
+            rows,
+            ["shard", "targets", "quar", "family", "leaves (* = freshest)"]))
     else:
         out.append("no shard topology published (is this a root aggregator?)")
     f = doc["fleet"]
     footer = (f"fleet: {f['targets_up']}/{f['targets']} targets up · "
               f"{f['chips']:g} chips")
+    family_chips = f.get("family_chips") or {}
+    if family_chips:
+        # Per-family split of the chip/memory totals (mixed fleets): e.g.
+        # "tpu 96 chips 1.2TiB · gpu 16 chips 320GiB".
+        family_hbm = f.get("family_hbm_used_bytes") or {}
+        cells = []
+        for fam in sorted(family_chips):
+            cell = f"{fam} {family_chips[fam]:g} chips"
+            if fam in family_hbm:
+                cell += f" {fmt_bytes(family_hbm[fam])}"
+            cells.append(cell)
+        footer += " (" + " · ".join(cells) + ")"
     if f.get("dedup_stale_wins_total") is not None:
         footer += f" · stale wins {f['dedup_stale_wins_total']:g}"
     if f.get("reshard_moves_total") is not None:

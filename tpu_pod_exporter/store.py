@@ -1283,7 +1283,7 @@ def run_retention_demo(
         for sl in range(8):
             b.add(schema.TPU_SLICE_HBM_USED_BYTES,
                   float((sl + 1) * 2**30 + r * 4096),
-                  (f"slice-{sl}", "v5p"))
+                  (f"slice-{sl}", "v5p", "tpu"))
         return b.build(timestamp=sim["wall"])
 
     rounds = int(total_s // sim_round_s)
