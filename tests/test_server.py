@@ -672,6 +672,121 @@ class TestDebugStacks:
         assert f"({threading.main_thread().name})" in text
 
 
+class TestEventLoopRobustness:
+    """Failure shapes specific to the event-loop server: a buggy worker
+    task must still answer, and an error-closing connection must stop
+    being read."""
+
+    def test_worker_task_exception_answers_500(self):
+        """An unexpected exception in a deferred worker task (here: a
+        history backend raising TypeError) must produce a 500 and close —
+        not a silently wedged connection that hangs the client forever."""
+
+        class BrokenHistory:
+            def series_list(self):
+                raise TypeError("backend bug")
+
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(
+            store, host="127.0.0.1", port=0, history=BrokenHistory()
+        )
+        server.start()
+        try:
+            status, _, body = get(
+                f"http://127.0.0.1:{server.port}/api/v1/series"
+            )
+            assert status == 500
+            assert b"internal error" in body
+        finally:
+            server.stop()
+
+    def test_worker_pool_burst_runs_in_parallel(self):
+        """A burst of submits landing while one worker idles in cv.wait
+        must spawn more workers (up to the cap), not serialize the whole
+        batch onto the single idle thread via lost notify()s."""
+        import threading
+        import time
+
+        from tpu_pod_exporter.server import _WorkerPool
+
+        pool = _WorkerPool(4)
+        primed = threading.Event()
+        pool.submit(primed.set)
+        assert primed.wait(2)
+        time.sleep(0.1)  # let the worker reach its idle cv.wait
+        lock = threading.Lock()
+        active = 0
+        peak = 0
+        done = []
+
+        def task():
+            nonlocal active, peak
+            with lock:
+                active += 1
+                peak = max(peak, active)
+            time.sleep(0.3)
+            with lock:
+                active -= 1
+                done.append(1)
+
+        for _ in range(3):
+            pool.submit(task)
+        deadline = time.monotonic() + 5
+        while len(done) < 3 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        pool.shutdown()
+        assert len(done) == 3
+        assert peak >= 2, "burst serialized onto a single worker"
+
+    def test_headerless_stream_gets_at_most_one_431_then_dies(self):
+        """A client streaming bytes with no header terminator must be cut
+        off after at most one 431 — never one error response per recv
+        while its buffer grows at the client's send rate. (The server
+        closes with client bytes still unread, so the teardown may be an
+        RST that discards the in-flight 431 — 'at most one, then dead
+        fast' is the invariant.)"""
+        import socket
+        import time
+
+        store = SnapshotStore()
+        put_snapshot(store)
+        server = MetricsServer(store, host="127.0.0.1", port=0)
+        server.start()
+        try:
+            s = socket.create_connection(("127.0.0.1", server.port),
+                                         timeout=5)
+            junk = b"x" * 65536
+            got = b""
+            dead = False
+            deadline = time.monotonic() + 5
+            while time.monotonic() < deadline:
+                try:
+                    s.sendall(junk)
+                except OSError:
+                    dead = True
+                    break
+                try:
+                    s.settimeout(0.05)
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        dead = True
+                        break
+                    got += chunk
+                except TimeoutError:
+                    continue
+                except OSError:
+                    dead = True
+                    break
+                finally:
+                    s.settimeout(5)
+            assert dead, "server kept the header-less stream alive"
+            assert got.count(b"HTTP/1.1 431") <= 1
+            s.close()
+        finally:
+            server.stop()
+
+
 class TestClientWriteTimeout:
     """Slow-client write defense (--client-write-timeout-s): a scraper that
     stops reading mid-body must not pin a handler thread — the blocked

@@ -42,6 +42,7 @@ from tpu_pod_exporter.collector import CollectorLoop
 from tpu_pod_exporter.metrics import (
     CounterStore,
     HistogramStore,
+    PrefixCache,
     SnapshotBuilder,
     SnapshotStore,
 )
@@ -712,6 +713,7 @@ class SliceAggregator:
         shipper=None,  # egress.RemoteWriteShipper; None = no push egress
         targets_file: str = "",  # live membership: re-read on mtime change
         target_filter=None,  # (tuple) -> iterable; the leaf tier's shard cut
+        render_splice: bool = True,  # --render-splice; the RUNBOOK kill switch
     ) -> None:
         if not targets and not targets_file:
             raise ValueError("aggregator needs at least one target")
@@ -745,6 +747,13 @@ class SliceAggregator:
         self._recorder = recorder
         self._loop_overruns_fn = loop_overruns_fn
         self._store = store
+        # Splice render across rounds (same machinery as the exporter
+        # tier): rollup label sets are stable between target churn events,
+        # so each round splices changed cells instead of re-rendering the
+        # whole aggregate exposition. Same kill switch as the exporter
+        # (--render-splice false), so the RUNBOOK bisection step applies
+        # on every tier.
+        self._prefix_cache = PrefixCache(splice=render_splice)
         self._timeout_s = timeout_s
         self._fetch = fetch
         # Missed-round continuity (0 disables): when a target's full scrape
@@ -1076,7 +1085,7 @@ class SliceAggregator:
     def _publish(self, results, fallbacks=None,
                  round_started: float | None = None,
                  quarantined: set | None = None) -> None:
-        b = SnapshotBuilder()
+        b = SnapshotBuilder(prefix_cache=self._prefix_cache)
         for spec in schema.AGGREGATE_SPECS:
             b.declare(spec)
         fallbacks = fallbacks or {}
@@ -1353,10 +1362,14 @@ class SliceAggregator:
         """Introspection payload for /debug/vars — the aggregator twin of
         ExporterApp._debug_vars. Reads are cross-thread but safe: layout
         lists are swapped atomically by the publish thread."""
+        tmpl = self._prefix_cache.template
         return {
             "targets": list(self._targets),
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
+            # Splice-render counters (None = --render-splice false); the
+            # RUNBOOK's render triage reads the same shape on every tier.
+            "render": tmpl.stats() if tmpl is not None else None,
             # Cumulative membership changes (targets-file reloads / leaf
             # resharding); 0 forever on a static --targets deployment.
             "target_moves": self._tset.moves,
@@ -1460,6 +1473,12 @@ def main(argv: list[str] | None = None) -> int:
                         "JSON) so a restarted aggregator keeps its "
                         "quarantines instead of re-learning every dead "
                         "target from closed; empty disables")
+    p.add_argument("--render-splice", default="on", choices=("on", "off"),
+                   help="incremental exposition render (splice changed "
+                        "cells into a pre-rendered body template per "
+                        "round); off restores the per-family full "
+                        "re-render — the RUNBOOK's bisection step, same "
+                        "switch as the exporter tier")
     p.add_argument("--trace", default="on", choices=("on", "off"),
                    help="round tracing: one trace per aggregation round "
                         "with per-target scrape spans, exported at "
@@ -1594,6 +1613,7 @@ def main(argv: list[str] | None = None) -> int:
         breaker_store=breaker_store,
         shipper=shipper,
         targets_file=ns.targets_file,
+        render_splice=ns.render_splice == "on",
     )
     fleet = None
     if ns.fleet_query == "on":

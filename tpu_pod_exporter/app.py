@@ -442,6 +442,7 @@ class ExporterApp:
             shipper=self.shipper,
             governor=self.governor,
             client_write_timeouts_fn=lambda: self.server.write_timeouts["total"],
+            render_splice=cfg.render_splice,
         )
         self.loop = CollectorLoop(self.collector, interval_s=cfg.interval_s)
         # Liveness trips when the poll thread stops swapping snapshots
@@ -465,6 +466,7 @@ class ExporterApp:
             warm_fn=self._warm_state,
             max_open_connections=cfg.max_open_connections,
             max_requests_per_client=cfg.max_requests_per_client,
+            max_workers=cfg.server_max_workers,
         )
 
     def _warm_state(self) -> dict | None:
@@ -561,7 +563,17 @@ class ExporterApp:
             "series": snap.series_count,
             "snapshot_age_s": max(time.time() - snap.timestamp, 0.0),
             "scrape_rejects": dict(self.server.scrape_rejects),
+            # Event-loop serving counters (slow-client drops, inline vs
+            # worker split) — the RUNBOOK's first stop for scrape-path
+            # triage.
+            "server": self.server.stats(),
         }
+        render = self.collector.render_stats()
+        if render is not None:
+            # Splice-render cache: generation bumps on layout churn,
+            # revision on any byte change; spliced_cells vs rebuilt_blocks
+            # shows whether the incremental path is actually incremental.
+            out["render"] = render
         if self.process_scanner is not None:
             out["process_scanner"] = {
                 "full_scans": self.process_scanner.full_scans,

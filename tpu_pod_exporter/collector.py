@@ -120,6 +120,9 @@ class Collector:
         governor: Any = None,
         # () -> int, from the HTTP server
         client_write_timeouts_fn: Callable[[], int] | None = None,
+        # Incremental splice render (--render-splice); False restores the
+        # per-family full re-render at every poll.
+        render_splice: bool = True,
         clock: Callable[[], float] = time.monotonic,
         wallclock: Callable[[], float] = time.time,
     ) -> None:
@@ -189,7 +192,7 @@ class Collector:
         # lines/day. Per-instance: multiple collectors (tests, bench)
         # must not suppress each other.
         self._rlog = RateLimitedLogger(log)
-        self._prefix_cache = PrefixCache()
+        self._prefix_cache = PrefixCache(splice=render_splice)
         # Topology labels are fixed for the process lifetime; pre-order them
         # once for the tuple fast path (CHIP_LABELS[2:6]).
         t = self._topology.labels()
@@ -250,6 +253,12 @@ class Collector:
         # monotonic time of the previous published device sample, for rates
         self._prev_ici_at: float | None = None
         self.last_stats = PollStats()
+
+    def render_stats(self) -> dict[str, int] | None:
+        """Splice-render counters for /debug/vars, or None when the
+        incremental render is disabled (--render-splice false)."""
+        tmpl = self._prefix_cache.template
+        return tmpl.stats() if tmpl is not None else None
 
     # ------------------------------------------------------------------ poll
 

@@ -185,8 +185,8 @@ class ExporterConfig:
     # history rings cut. 0 disables the memory ladder entirely.
     memory_budget_mb: float = 0.0
     # Scrape-storm admission control: hard cap on concurrently OPEN
-    # connections (a keep-alive storm parks handler threads and eats file
-    # descriptors on a thread-per-connection server); over-cap connections
+    # connections (each costs a file descriptor and loop bookkeeping,
+    # even on the event loop); over-cap connections
     # get the pre-rendered 429 + Retry-After and are closed — except
     # /healthz + /readyz, which always answer. 0 disables.
     max_open_connections: int = 256
@@ -194,14 +194,26 @@ class ExporterConfig:
     # not monopolize the scrape/api fences for everyone else); same 429 +
     # probe-path exemption. 0 disables.
     max_requests_per_client: int = 32
-    # Slow-client write defense: per-connection socket SEND timeout. A
-    # scraper that stalls mid-body (stuck TCP peer, frozen pipe) gets its
-    # connection dropped after this many seconds instead of pinning a
-    # handler thread forever; counted in
-    # tpu_exporter_client_write_timeouts_total. 0 disables. Send-only
-    # (SO_SNDTIMEO): idle keep-alive connections between scrapes are
-    # unaffected.
+    # Slow-client write defense: per-connection WRITE-PROGRESS deadline
+    # on the event loop. A scraper that stalls mid-body (stuck TCP peer,
+    # frozen pipe, trickle reader) makes zero write progress for this
+    # many seconds and gets its connection dropped; counted in
+    # tpu_exporter_client_write_timeouts_total. 0 disables. Write-only:
+    # idle keep-alive connections between scrapes are unaffected, and a
+    # slowly-draining client stays alive as long as bytes keep moving.
     client_write_timeout_s: float = 10.0
+    # Event-loop server worker pool cap: requests that may block (an
+    # uncached render, /api/v1 queries, /debug serialization) run on an
+    # elastic pool of at most this many threads; the cached-bytes scrape
+    # hot path never leaves the loop. The steady state is 0-1 workers —
+    # this bounds the worst case (a storm of uncacheable requests), not
+    # the common one.
+    server_max_workers: int = 8
+    # Incremental exposition render: keep a pre-rendered byte template
+    # keyed by the series-layout generation and splice only changed value
+    # cells per poll (plus per-encoding gzip/OpenMetrics caches invalidated
+    # by splice). false restores the per-family full re-render.
+    render_splice: bool = True
     # /debug/* exposure: by default debug endpoints only answer loopback
     # clients (run curl on the node). "0.0.0.0" serves them to any client
     # (the pre-round-5 behaviour); the metrics/health/api endpoints are

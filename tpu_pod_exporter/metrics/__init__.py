@@ -6,6 +6,7 @@ from tpu_pod_exporter.metrics.registry import (
     HistogramSpec,
     HistogramStore,
     MetricSpec,
+    PrefixCache,
     Snapshot,
     SnapshotBuilder,
     SnapshotStore,
@@ -19,6 +20,7 @@ __all__ = [
     "HistogramSpec",
     "HistogramStore",
     "MetricSpec",
+    "PrefixCache",
     "Snapshot",
     "SnapshotBuilder",
     "SnapshotStore",

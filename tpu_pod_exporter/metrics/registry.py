@@ -32,6 +32,11 @@ from bisect import bisect_left
 from dataclasses import dataclass, field
 from typing import Iterable, Mapping, Sequence
 
+try:  # C-speed value-vector diff for the splice render; optional.
+    import numpy as _np
+except ImportError:  # pragma: no cover - numpy is a declared dependency
+    _np = None  # type: ignore[assignment]
+
 GAUGE = "gauge"
 COUNTER = "counter"
 HISTOGRAM = "histogram"
@@ -192,10 +197,17 @@ class PrefixCache:
     render", layouts answer "what is this family's exact series order".
     """
 
-    def __init__(self, max_entries: int = 65536) -> None:
+    def __init__(self, max_entries: int = 65536, splice: bool = True) -> None:
         self._cache: dict[tuple[str, tuple[str, ...]], bytes] = {}
         self._layouts: dict[str, FamilyLayout] = {}
         self._max = max_entries
+        # Incremental exposition render (ISSUE 13): one template of the
+        # whole body is kept across polls and only changed value cells are
+        # spliced per snapshot. splice=False restores the per-family
+        # layout-block render (the pre-splice behaviour).
+        self.template: ExpositionTemplate | None = (
+            ExpositionTemplate(self) if splice else None
+        )
 
     def prefix(self, spec: MetricSpec, lvs: tuple[str, ...]) -> bytes:
         key = (spec.name, lvs)
@@ -215,6 +227,271 @@ class PrefixCache:
         rec = FamilyLayout(keys, [pfx(spec, k) for k in keys])
         self._layouts[spec.name] = rec
         return rec
+
+
+class BodySet:
+    """Per-encoding rendered bodies for ONE splice revision of the template.
+
+    A new BodySet is minted every time the template's bytes actually change
+    (a cell splice, a block rebuild, a layout churn) — that is the whole
+    invalidation story for the per-encoding caches: gzip and OpenMetrics
+    variants are derived lazily on first request and live exactly as long
+    as the identity body they encode. When consecutive polls produce
+    byte-identical expositions the SAME BodySet is handed to each snapshot,
+    so a gzip compressed for poll N is still served at poll N+k.
+
+    Lock-free by design: the optional fields are filled by plain attribute
+    stores (GIL-atomic). Two scrape threads racing the first gzip may both
+    compress; the results are byte-identical and the second store wins —
+    duplicate work once, never a lock held across compression (this
+    supersedes the old lazy-compress-under-lock idiom and its lock-io
+    lint escapes).
+    """
+
+    __slots__ = ("text", "revision", "generation", "openmetrics",
+                 "text_gzip", "openmetrics_gzip")
+
+    def __init__(self, text: bytes, revision: int, generation: int) -> None:
+        self.text = text
+        self.revision = revision
+        self.generation = generation
+        self.openmetrics: bytes | None = None
+        self.text_gzip: bytes | None = None
+        self.openmetrics_gzip: bytes | None = None
+
+
+class _TemplateFamily:
+    """One family's slice of the exposition template: the rendered sample
+    block plus everything needed to splice new values into it in place."""
+
+    __slots__ = ("spec", "layout", "header", "values", "cells", "offsets",
+                 "buf")
+
+    def __init__(self, spec: MetricSpec, layout: FamilyLayout | None,
+                 header: bytes) -> None:
+        self.spec = spec
+        self.layout = layout
+        self.header = header
+        self.values: array = array("d")
+        # Formatted value bytes per series, aligned with layout.keys.
+        self.cells: list[bytes] = []
+        # Byte offset of each value cell inside ``buf``.
+        self.offsets: list[int] = []
+        self.buf = bytearray()
+
+    def rebuild(self, values: array) -> None:
+        """Re-render the block from prefixes + current cell bytes. Called
+        when the layout changed or a cell's formatted width changed; cells
+        for unchanged values are reused, so the cost is the byte join, not
+        re-formatting every float."""
+        layout = self.layout
+        assert layout is not None
+        cells = self.cells
+        parts: list[bytes] = []
+        offsets: list[int] = []
+        off = 0
+        for prefix, cell in zip(layout.prefixes, cells):
+            parts.append(prefix)
+            parts.append(b" ")
+            parts.append(cell)
+            parts.append(b"\n")
+            off += len(prefix) + 1
+            offsets.append(off)
+            off += len(cell) + 1
+        self.buf = bytearray(b"".join(parts))
+        self.offsets = offsets
+        self.values = values
+
+
+class ExpositionTemplate:
+    """Pre-rendered exposition bytes spliced incrementally across polls.
+
+    The template holds the full text-format body as per-family blocks keyed
+    by the layout generation: between churn events the series set and order
+    of every family are identical poll to poll, so the only bytes that can
+    differ are the float cells. Per poll the value vector of each family is
+    diffed (C-level via numpy when available), changed cells are formatted
+    and spliced into the block bytearray in place when the width matches,
+    and a block is re-joined from cached line fragments when a width
+    changed. A layout change (labels added/evicted, a conditional surface
+    appearing) bumps ``generation`` and rebuilds the affected family from
+    its prefixes.
+
+    Thread contract: mutated only by the thread that calls
+    :meth:`Snapshot.encode` at swap time (the poll loop) — the same
+    single-writer rule the FamilyLayout cache always had. Scrape threads
+    only ever see the immutable bytes handed out through a :class:`BodySet`.
+    """
+
+    __slots__ = ("_cache", "_records", "_headers", "_bodyset", "generation",
+                 "revision", "polls", "spliced_cells", "rebuilt_blocks",
+                 "reused_blocks", "family_rebuilds")
+
+    # numpy wins over the Python zip-loop diff from roughly this many
+    # series (measured; below it the frombuffer overhead dominates).
+    _NUMPY_DIFF_MIN = 64
+
+    def __init__(self, cache: PrefixCache) -> None:
+        self._cache = cache
+        self._records: list[_TemplateFamily] = []
+        self._headers: dict[str, bytes] = {}
+        self._bodyset: BodySet | None = None
+        self.generation = 0   # bumped on any layout/family-set change
+        self.revision = 0     # bumped whenever the body bytes change
+        self.polls = 0
+        self.spliced_cells = 0
+        self.rebuilt_blocks = 0
+        self.reused_blocks = 0
+        self.family_rebuilds = 0
+
+    def stats(self) -> dict[str, int]:
+        """Render-cache counters for /debug/vars (RUNBOOK 'render')."""
+        return {
+            "generation": self.generation,
+            "revision": self.revision,
+            "polls": self.polls,
+            "families": len(self._records),
+            "spliced_cells": self.spliced_cells,
+            "rebuilt_blocks": self.rebuilt_blocks,
+            "reused_blocks": self.reused_blocks,
+            "family_rebuilds": self.family_rebuilds,
+        }
+
+    def _header_for(self, spec: MetricSpec) -> bytes:
+        if spec.suppress_header:
+            return b""
+        h = self._headers.get(spec.name)
+        if h is None:
+            h = (f"# HELP {spec.name} {escape_help(spec.help)}\n"
+                 f"# TYPE {spec.name} {spec.type}\n").encode()
+            self._headers[spec.name] = h
+        return h
+
+    def _build_family(self, spec: MetricSpec,
+                      samples: dict[tuple[str, ...], float]) -> _TemplateFamily:
+        self.family_rebuilds += 1
+        if not samples:
+            return _TemplateFamily(spec, None, self._header_for(spec))
+        layout = self._cache.layout(spec, tuple(samples))
+        rec = _TemplateFamily(spec, layout, self._header_for(spec))
+        values = array("d", samples.values())
+        rec.cells = [format_value(v).encode() for v in values]
+        rec.rebuild(values)
+        return rec
+
+    def _changed_indices(self, old: array, new: array) -> list[int]:
+        if _np is not None and len(new) >= self._NUMPY_DIFF_MIN:
+            a = _np.frombuffer(old, dtype=_np.float64)
+            b = _np.frombuffer(new, dtype=_np.float64)
+            # NaN cells compare unequal every poll; _splice_family skips
+            # them once their formatted bytes come out identical.
+            return _np.nonzero(a != b)[0].tolist()  # type: ignore[no-any-return]
+        return [i for i, (x, y) in enumerate(zip(old, new)) if x != y]
+
+    def _splice_family(self, rec: _TemplateFamily,
+                       samples: dict[tuple[str, ...], float]) -> bool:
+        """Fold one family's new values into its block. True if bytes
+        changed."""
+        new_values = array("d", samples.values())
+        if new_values == rec.values:
+            self.reused_blocks += 1
+            return False
+        idxs = self._changed_indices(rec.values, new_values)
+        if not idxs:
+            # Only representation-stable differences (NaN vs NaN compares
+            # unequal in the array fallback; numpy path returns them).
+            rec.values = new_values
+            self.reused_blocks += 1
+            return False
+        cells = rec.cells
+        resize = False
+        dirty = []
+        for i in idxs:
+            cell = format_value(new_values[i]).encode()
+            if cell == cells[i]:
+                # Representation-stable difference: a NaN cell compares
+                # unequal every poll but renders the same "NaN" bytes.
+                # Counting it as a change would mint a new BodySet per
+                # poll and discard the gzip/OpenMetrics caches for a
+                # byte-identical body.
+                continue
+            if len(cell) != len(cells[i]):
+                resize = True
+            cells[i] = cell
+            dirty.append(i)
+        if not dirty:
+            rec.values = new_values
+            self.reused_blocks += 1
+            return False
+        if resize:
+            rec.rebuild(new_values)
+            self.rebuilt_blocks += 1
+            return True
+        buf = rec.buf
+        offsets = rec.offsets
+        for i in dirty:
+            off = offsets[i]
+            buf[off:off + len(cells[i])] = cells[i]
+        rec.values = new_values
+        self.spliced_cells += len(dirty)
+        return True
+
+    def render(self, snapshot: "Snapshot") -> tuple[bytes, BodySet]:
+        """Produce the full text body for ``snapshot``, reusing the
+        template. Returns the immutable body plus the BodySet carrying its
+        per-encoding caches."""
+        self.polls += 1
+        families = snapshot._families
+        records = self._records
+        specs = [f.spec for f in families.values()]
+        aligned = (
+            len(records) == len(specs)
+            and all(
+                r.spec is s or r.spec == s
+                for r, s in zip(records, specs)
+            )
+        )
+        changed = False
+        if not aligned:
+            # Family set or order changed: new layout generation, rebuild
+            # the whole record list (prefixes still come from the cache).
+            self.generation += 1
+            records = [
+                self._build_family(fam.spec, fam.samples)
+                for fam in families.values()
+            ]
+            self._records = records
+            changed = True
+        else:
+            for idx, fam in enumerate(families.values()):
+                rec = records[idx]
+                if not fam.samples:
+                    if rec.layout is not None or rec.buf:
+                        # Series all churned away: header-only block now.
+                        self.generation += 1
+                        records[idx] = self._build_family(fam.spec, {})
+                        changed = True
+                    continue
+                layout = self._cache.layout(fam.spec, tuple(fam.samples))
+                if layout is not rec.layout:
+                    self.generation += 1
+                    records[idx] = self._build_family(fam.spec, fam.samples)
+                    changed = True
+                    continue
+                if self._splice_family(rec, fam.samples):
+                    changed = True
+        bodyset = self._bodyset
+        if changed or bodyset is None:
+            parts: list[bytes | bytearray] = []
+            for rec in records:
+                if rec.header:
+                    parts.append(rec.header)
+                if rec.buf:
+                    parts.append(rec.buf)
+            self.revision += 1
+            bodyset = BodySet(b"".join(parts), self.revision, self.generation)
+            self._bodyset = bodyset
+        return bodyset.text, bodyset
 
 
 class SnapshotBuilder:
@@ -329,9 +606,11 @@ class Snapshot:
         self._prefix_cache = prefix_cache
         self._text: bytes | None = None
         self._gzipped: bytes | None = None
-        self._gzip_lock = threading.Lock()
         self._openmetrics: bytes | None = None
         self._openmetrics_gzipped: bytes | None = None
+        # Set by the template render path: shares per-encoding bodies
+        # (gzip, OpenMetrics) across snapshots whose bytes did not change.
+        self._bodyset: BodySet | None = None
 
     @property
     def series_count(self) -> int:
@@ -380,12 +659,18 @@ class Snapshot:
         """
         if self._text is not None:
             return self._text
+        cache = self._prefix_cache
+        if cache is not None and cache.template is not None:
+            # Incremental path: splice changed cells into the shared
+            # template instead of re-rendering ~1 MB per poll. Single
+            # writer (the poll thread) by the template's thread contract.
+            self._text, self._bodyset = cache.template.render(self)
+            return self._text
         try:
             from tpu_pod_exporter.metrics import native
         except ImportError:  # partial deployment: never let encode() die
             native = None
 
-        cache = self._prefix_cache
         chunks: list[bytes] = []
         for fam in self._families.values():
             spec = fam.spec
@@ -433,8 +718,13 @@ class Snapshot:
         header lines name the family *without* its ``_total`` suffix, and the
         body ends with ``# EOF``. So this is a handful of header rewrites on
         the cached bytes, not a second render."""
-        if self._openmetrics is not None:
-            return self._openmetrics
+        om = self._openmetrics
+        if om is not None:
+            return om
+        bs = self._bodyset
+        if bs is not None and bs.openmetrics is not None:
+            self._openmetrics = bs.openmetrics
+            return bs.openmetrics
 
         def _rewrite(body: bytes, old: bytes, new: bytes) -> bytes:
             # Anchor the needle on a line start so a HELP text that happens
@@ -445,50 +735,94 @@ class Snapshot:
                 return new + body[len(old):]
             return body.replace(b"\n" + old, b"\n" + new, 1)
 
-        with self._gzip_lock:
-            if self._openmetrics is None:
-                om = self.encode()
-                for fam in self._families.values():
-                    spec = fam.spec
-                    if spec.type == COUNTER and spec.name.endswith("_total"):
-                        base = spec.name[: -len("_total")]
-                        om = _rewrite(
-                            om,
-                            f"# HELP {spec.name} ".encode(),
-                            f"# HELP {base} ".encode(),
-                        )
-                        om = _rewrite(
-                            om,
-                            f"# TYPE {spec.name} counter".encode(),
-                            f"# TYPE {base} counter".encode(),
-                        )
-                self._openmetrics = om + b"# EOF\n"
-        return self._openmetrics
+        om = self.encode()
+        for fam in self._families.values():
+            spec = fam.spec
+            if spec.type == COUNTER and spec.name.endswith("_total"):
+                base = spec.name[: -len("_total")]
+                om = _rewrite(
+                    om,
+                    f"# HELP {spec.name} ".encode(),
+                    f"# HELP {base} ".encode(),
+                )
+                om = _rewrite(
+                    om,
+                    f"# TYPE {spec.name} counter".encode(),
+                    f"# TYPE {base} counter".encode(),
+                )
+        om = om + b"# EOF\n"
+        # Lock-free publish (GIL-atomic stores): two scrape threads racing
+        # here both derive byte-identical bodies; the second store wins.
+        self._openmetrics = om
+        if bs is not None:
+            bs.openmetrics = om
+        return om
 
     def encode_openmetrics_gzip(self) -> bytes:
-        if self._openmetrics_gzipped is None:
-            import gzip
+        gz = self._openmetrics_gzipped
+        if gz is not None:
+            return gz
+        bs = self._bodyset
+        if bs is not None and bs.openmetrics_gzip is not None:
+            self._openmetrics_gzipped = bs.openmetrics_gzip
+            return bs.openmetrics_gzip
+        import gzip
 
-            body = self.encode_openmetrics()
-            with self._gzip_lock:
-                if self._openmetrics_gzipped is None:
-                    self._openmetrics_gzipped = gzip.compress(body, compresslevel=1)  # lint: disable=lock-io(lazy once-per-snapshot cache; this lock exists to serialize exactly this compress, never taken by the poll thread)
-        return self._openmetrics_gzipped
+        gz = gzip.compress(self.encode_openmetrics(), compresslevel=1)
+        self._openmetrics_gzipped = gz
+        if bs is not None:
+            bs.openmetrics_gzip = gz
+        return gz
 
     def encode_gzip(self) -> bytes:
         """Gzipped exposition, compressed lazily on the first gzip-accepting
         scrape of this snapshot (then cached). Compressing eagerly at swap
         time would cost ~2 ms per poll even when Prometheus scrapes far less
         often than the 1 s poll interval; lazily, the cost lands once per
-        scraped snapshot. Thread-safe: scrape threads race benignly behind a
-        lock."""
-        if self._gzipped is None:
-            import gzip
+        SPLICE REVISION: the BodySet carries the compressed bytes across
+        snapshots whose exposition did not change. Thread-safe without a
+        lock — racing scrapers may both compress once (identical output,
+        GIL-atomic publish), and no thread ever holds a lock across the
+        compression."""
+        gz = self._gzipped
+        if gz is not None:
+            return gz
+        bs = self._bodyset
+        if bs is not None and bs.text_gzip is not None:
+            self._gzipped = bs.text_gzip
+            return bs.text_gzip
+        import gzip
 
-            with self._gzip_lock:
-                if self._gzipped is None:
-                    self._gzipped = gzip.compress(self.encode(), compresslevel=1)  # lint: disable=lock-io(lazy once-per-snapshot cache; this lock exists to serialize exactly this compress, never taken by the poll thread)
-        return self._gzipped
+        gz = gzip.compress(self.encode(), compresslevel=1)
+        self._gzipped = gz
+        if bs is not None:
+            bs.text_gzip = gz
+        return gz
+
+    def cached_exposition(self, openmetrics: bool = False,
+                          gzipped: bool = False) -> bytes | None:
+        """Already-rendered body for one (format, encoding) pair, or None.
+
+        The event-loop server's inline fast path: a scrape whose body is
+        already cached (the common case — the poll thread pre-encodes the
+        identity body at swap, and gzip/OpenMetrics variants persist on the
+        BodySet across unchanged revisions) is served straight off the
+        loop with zero blocking work; a None sends the request to a worker
+        thread, which may render."""
+        bs = self._bodyset
+        if openmetrics:
+            if gzipped:
+                if self._openmetrics_gzipped is not None:
+                    return self._openmetrics_gzipped
+                return bs.openmetrics_gzip if bs is not None else None
+            if self._openmetrics is not None:
+                return self._openmetrics
+            return bs.openmetrics if bs is not None else None
+        if gzipped:
+            if self._gzipped is not None:
+                return self._gzipped
+            return bs.text_gzip if bs is not None else None
+        return self._text
 
 
 EMPTY_SNAPSHOT = Snapshot({}, timestamp=0.0)

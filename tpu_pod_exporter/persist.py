@@ -249,7 +249,6 @@ class RestoredSnapshot:
         self._gzipped: bytes | None = None
         self._openmetrics: bytes | None = None
         self._openmetrics_gzipped: bytes | None = None
-        self._lock = threading.Lock()
         self._series_count: int | None = None
 
     @property
@@ -265,34 +264,44 @@ class RestoredSnapshot:
         return self._body
 
     def encode_gzip(self) -> bytes:
-        if self._gzipped is None:
+        # Lock-free lazy cache (same idiom as registry.BodySet): racing
+        # scrapers may both compress once — identical bytes, GIL-atomic
+        # publish, and no thread ever holds a lock across the compression.
+        gz = self._gzipped
+        if gz is None:
             import gzip
 
-            with self._lock:
-                if self._gzipped is None:
-                    self._gzipped = gzip.compress(self._body, compresslevel=1)  # lint: disable=lock-io(lazy warm-start cache; lock serializes exactly this compress, restore-time only)
-        return self._gzipped
+            gz = gzip.compress(self._body, compresslevel=1)
+            self._gzipped = gz
+        return gz
 
     def encode_openmetrics(self) -> bytes:
-        if self._openmetrics is None:
-            with self._lock:
-                if self._openmetrics is None:
-                    self._openmetrics = (
-                        _rewrite_counter_headers(self._body) + b"# EOF\n"
-                    )
-        return self._openmetrics
+        om = self._openmetrics
+        if om is None:
+            om = _rewrite_counter_headers(self._body) + b"# EOF\n"
+            self._openmetrics = om
+        return om
 
     def encode_openmetrics_gzip(self) -> bytes:
-        if self._openmetrics_gzipped is None:
+        gz = self._openmetrics_gzipped
+        if gz is None:
             import gzip
 
-            body = self.encode_openmetrics()
-            with self._lock:
-                if self._openmetrics_gzipped is None:
-                    self._openmetrics_gzipped = gzip.compress(  # lint: disable=lock-io(lazy warm-start cache; lock serializes exactly this compress, restore-time only)
-                        body, compresslevel=1
-                    )
-        return self._openmetrics_gzipped
+            gz = gzip.compress(self.encode_openmetrics(), compresslevel=1)
+            self._openmetrics_gzipped = gz
+        return gz
+
+    def cached_exposition(self, openmetrics: bool = False,
+                          gzipped: bool = False) -> bytes | None:
+        """Event-loop fast path (see ``Snapshot.cached_exposition``): the
+        restored identity body is always in memory; derived encodings are
+        served inline once the first (worker-rendered) request cached
+        them."""
+        if openmetrics:
+            return self._openmetrics_gzipped if gzipped else self._openmetrics
+        if gzipped:
+            return self._gzipped
+        return self._body
 
 
 # ------------------------------------------------------------------- restore

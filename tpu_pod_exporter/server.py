@@ -2,13 +2,29 @@
 
 Analog of the reference's promhttp goroutine (``main.go:67-72``), with the
 scrape path made collection-free *and* render-free: the poll loop pre-encodes
-the exposition text into the SnapshotStore, so a scrape is one lock, one
-reference read, and one ``sendall`` of cached bytes. This is what keeps p99
-scrape latency flat regardless of chip count (SURVEY.md §3.3, §7 "hard
-parts").
+the exposition text into the SnapshotStore, so a scrape is one reference
+read and one kernel copy of cached bytes. This is what keeps p99 scrape
+latency flat regardless of chip count (SURVEY.md §3.3, §7 "hard parts").
 
-Additional endpoints the reference lacks:
-- ``/healthz`` — liveness (process up, returns 200 always).
+Serving architecture (ISSUE 13 rewrite): a single ``selectors``-based event
+loop owns every socket — accept, request parse, and all response writes —
+so a thousand idle keep-alive scrapers or trickle-reading clients cost file
+descriptors, not threads. Work that may block (an uncached render, the
+/api/v1 history/fleet queries, /debug serialization) is handed to a small
+elastic worker pool whose results are written back by the loop; the common
+scrape (body already cached on the snapshot) is served entirely inline.
+The pre-event-loop defenses carry over as natural loop constructs:
+
+- ``--client-write-timeout-s`` (SO_SNDTIMEO on the old thread-per-connection
+  server) is now a per-connection write-progress deadline: a client that
+  stalls mid-body is dropped and counted once no byte has moved for that
+  long.
+- The scrape-rate tarpit is a loop timer, not a sleeping thread.
+- Admission control (connection cap, per-client cap) and the pre-rendered
+  429 + Retry-After paths run inline on the loop before any work is spent.
+
+Endpoints the reference lacks:
+- ``/healthz`` — liveness (200 unless the poll loop is provably wedged).
 - ``/readyz`` — readiness JSON (200 once data is being served, 503
   before) with a ``state`` field: ``starting`` / ``warm`` (serving a
   restored pre-restart snapshot, first live poll pending — see
@@ -25,30 +41,33 @@ Additional endpoints the reference lacks:
   (thread stacks, config and traces are operator surface, not fleet
   surface); ``--debug-addr 0.0.0.0`` restores remote access.
 
-The server is a stdlib ThreadingHTTPServer: no event-loop dependency, a few
-concurrent scrapers at most (Prometheus), and request handling does no
-per-request allocation beyond headers.
+Probe routes (/healthz, /readyz, /) answer inline on the loop so a scrape
+storm or a wedged render can never starve kubelet; their optional hooks
+(``live_fn``/``ready_detail_fn``/``warm_fn``) must therefore stay
+non-blocking — every in-repo hook is a lock-free stats read.
 """
 
 from __future__ import annotations
 
+import heapq
 import json
 import logging
 import math
+import selectors
 import socket
-import struct
 import threading
 import time
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from collections import deque
+from typing import Any, Callable
 from urllib.parse import parse_qs
 
 from tpu_pod_exporter.metrics import SnapshotStore
 from tpu_pod_exporter.trace import parse_traceparent, to_chrome_trace
 
 
-def _json_sanitize(obj):
+def _json_sanitize(obj: Any) -> Any:
     """Replace non-finite floats with None, recursively (slow path of
-    _serve_json — only runs when a response actually contains one)."""
+    JSON serving — only runs when a response actually contains one)."""
     if isinstance(obj, float):
         return obj if math.isfinite(obj) else None
     if isinstance(obj, dict):
@@ -96,20 +115,21 @@ def _format_stacks() -> str:
         out.append("")
     return "\n".join(out) + "\n"
 
+
 log = logging.getLogger("tpu_pod_exporter.server")
 
 CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 OPENMETRICS_CONTENT_TYPE = "application/openmetrics-text; version=1.0.0; charset=utf-8"
 
+
 def prerender_429(body: bytes, content_type: str) -> bytes:
     """A 429 + Retry-After response as raw wire bytes, rendered once at
-    import: under a storm the reject path runs per request, and
-    BaseHTTPRequestHandler.send_response formats a Date header and three
-    header lines each time — measurable CPU that a reject must not spend.
-    ``Connection: close`` both caps the handler thread's lifetime and tells
-    well-behaved clients to back off the keep-alive connection. Shared by
-    the /metrics scrape guard and the /api/v1 query fence (exporter and
-    aggregator both — extracted, not duplicated)."""
+    import: under a storm the reject path runs per request, and formatting
+    a status line plus four headers each time is measurable CPU that a
+    reject must not spend. ``Connection: close`` both caps the connection's
+    lifetime and tells well-behaved clients to back off the keep-alive
+    connection. Shared by the /metrics scrape guard and the /api/v1 query
+    fence (exporter and aggregator both — extracted, not duplicated)."""
     return (
         b"HTTP/1.1 429 Too Many Requests\r\n"
         b"Content-Type: " + content_type.encode("ascii") + b"\r\n"
@@ -178,7 +198,7 @@ def accepts_openmetrics(accept: str) -> bool:
 
 
 class _TokenBucket:
-    """Scrape-rate cap for /metrics. The concurrency semaphore bounds how
+    """Scrape-rate cap for /metrics. The concurrency fence bounds how
     many big bodies are in flight, but not how many per second — and a
     sequential storm of full-body scrapes is pure kernel-copy cost
     (~0.4 ms CPU per ~950 KB body at 256 chips; measured, bench.py) that
@@ -221,385 +241,1059 @@ class _TokenBucket:
             self.tokens = min(self.burst, self.tokens + 1.0)
 
 
-class _Handler(BaseHTTPRequestHandler):
-    # set by server factory
-    store: SnapshotStore
-    debug_vars = None  # optional callable -> dict
-    # Optional HistoryStore serving /api/v1/*; None = history disabled.
-    history = None
-    # Optional fleet.FleetQueryPlane: when set (the aggregator), /api/v1/*
-    # routes are answered by the federated fan-out instead of a local
-    # history store, behind the same api_sem fence.
-    fleet = None
-    # Optional trace.TraceStore: serves GET /debug/trace (Chrome
-    # trace_event JSON) and records a node-side scrape span whenever a
-    # /metrics request carries a traceparent header (the aggregator's
-    # fan-out propagation). None = tracing disabled (--trace off).
-    trace = None
-    # Concurrency fence for /api/v1/*: queries copy ring contents (cheap,
-    # but not free at 256-chip scale) and ThreadingHTTPServer spawns a
-    # thread per request — without a cap, a flood of history queries could
-    # keep the store lock contended against the poll thread's append.
-    # Small and separate from the scrape semaphore: the aggregator's
-    # missed-round fallback must not queue behind a scrape storm.
-    api_sem: threading.BoundedSemaphore | None = None
-    api_queue_timeout_s: float = 0.25
-    # /debug/* exposure policy (see debug_client_allowed).
-    debug_addr: str = "127.0.0.1"
-    # /healthz fails when the newest snapshot is older than this (0 = never).
-    # A poll thread wedged inside a hung device runtime stops swapping
-    # snapshots; liveness must catch that so kubelet restarts the pod —
-    # serving stale bytes forever would look "up" while monitoring nothing.
-    health_max_age_s: float = 0.0
-    # Optional () -> str|None liveness hook, checked before the staleness
-    # rule: a non-None reason fails /healthz IMMEDIATELY (e.g. the poll
-    # loop thread died and its one restart is spent) instead of waiting
-    # health_max_age_s for the snapshot to go stale.
-    live_fn = None
-    # Optional () -> dict merged into the /readyz JSON body — degraded
-    # readiness detail (e.g. sources whose circuit breaker has been open
-    # across several probes). Detail only: it never flips the status code;
-    # a degraded-but-serving exporter must keep its endpoints in rotation.
-    ready_detail_fn = None
-    # Concurrency guard for /metrics: at most N handlers render/send at
-    # once; excess requests queue briefly, then get 429 + Retry-After. A
-    # misconfigured scrape storm (BENCH: ~1k scrapes/s ate half a core)
-    # must not be able to starve the workload's cores — monitoring losing
-    # a scrape beats monitoring stealing the TPU host's CPU.
-    scrape_sem: threading.BoundedSemaphore | None = None
-    scrape_queue_timeout_s: float = 0.25
-    scrape_bucket: _TokenBucket | None = None
-    # Rate-cap rejects sleep this long before answering: a fast 429 just
-    # makes a storming client retry faster (measured: a sequential storm
-    # against an instant reject still ate >30% of a core in connection
-    # churn alone), while a tarpitted one is throttled to ~10 attempts/s
-    # per connection. Sleeping threads cost memory, not CPU; the slot cap
-    # below keeps a massively-concurrent flood from parking unbounded
-    # threads (overflow rejects immediately).
-    scrape_tarpit_s: float = 0.1
-    tarpit_slots: threading.BoundedSemaphore | None = None
-    scrape_rejects = None  # {"concurrency": int, "rate": int}, shared per server
-    scrape_rejects_lock: threading.Lock | None = None
-    # Optional (duration_s: float) -> None, called for every SERVED scrape
-    # (rejects excluded — a tarpit sleep is not a scrape latency). Feeds the
-    # tpu_exporter_scrape_duration_seconds histogram; must stay cheap, it
-    # runs on the scrape path.
-    scrape_observer = None
-    # Admission control (resource-pressure ISSUE 10): a hard cap on OPEN
-    # connections (keep-alive scrapers parked on handler threads are the
-    # FD/thread cost a storm inflicts on a thread-per-connection server)
-    # plus a per-client-IP concurrent-request cap. Over-cap connections
-    # are answered with the pre-rendered 429 + Retry-After and closed —
-    # except the kubelet probe paths, which always answer (a storm must
-    # not restart the pod). None/0 = disabled (the exporter app enables
-    # them via --max-open-connections / --max-requests-per-client).
-    conn_slots: threading.BoundedSemaphore | None = None
-    conn_stats = None   # {"open": int, "peak": int}, shared per server
-    conn_lock: threading.Lock | None = None
-    max_requests_per_client: int = 0
-    client_active = None  # {ip: concurrent requests}, shared per server
-    client_lock: threading.Lock | None = None
-    # Slow-client write defense: per-connection socket SEND timeout
-    # (SO_SNDTIMEO — receive-side keep-alive idling is unaffected). A
-    # scraper that stops reading mid-body would otherwise pin this handler
-    # thread inside sendall() forever; with the option set, the blocked
-    # send raises after this many seconds, the connection is dropped, and
-    # the drop is counted (tpu_exporter_client_write_timeouts_total).
-    client_write_timeout_s: float = 10.0
-    write_timeouts = None  # {"total": int}, shared per server
-    write_timeouts_lock: threading.Lock | None = None
-    # Optional () -> dict|None: non-None means the server is WARM-serving a
-    # restored pre-restart snapshot (no live poll yet); merged into the
-    # /readyz body as state="warm" detail. See tpu_pod_exporter.persist.
-    warm_fn = None
-    protocol_version = "HTTP/1.1"
+# --------------------------------------------------------------- HTTP pieces
 
-    def setup(self) -> None:
-        super().setup()
-        # Connection admission: a slot is held for the connection's whole
-        # lifetime (keep-alive included). Over-cap connections still get
-        # ONE request handled — 429 for anything but the probe paths —
-        # then close; the cost of that bounded courtesy is one short-lived
-        # thread, not a parked one.
-        self._admitted = True
-        slots = self.conn_slots
-        if slots is not None:
-            self._admitted = slots.acquire(blocking=False)
-        if self.conn_stats is not None and self._admitted:
-            with self.conn_lock:
-                self.conn_stats["open"] += 1
-                if self.conn_stats["open"] > self.conn_stats["peak"]:
-                    self.conn_stats["peak"] = self.conn_stats["open"]
-        t = self.client_write_timeout_s
-        if t > 0:
-            try:
-                # struct timeval: two C longs on every platform this runs
-                # on (linux). Failure just means no write fence — never a
-                # refused connection.
-                self.connection.setsockopt(
-                    socket.SOL_SOCKET, socket.SO_SNDTIMEO,
-                    struct.pack("ll", int(t), int((t - int(t)) * 1e6)),
-                )
-            except (OSError, ValueError, struct.error):
-                pass
+_MAX_HEADER_BYTES = 65536
+# GET requests carry no meaningful body here; anything advertised beyond
+# this is refused rather than buffered (the loop must never hold unbounded
+# client bytes).
+_MAX_BODY_DISCARD = 1 << 20
 
-    def finish(self) -> None:
-        if getattr(self, "_admitted", True):
-            if self.conn_stats is not None:
-                with self.conn_lock:
-                    self.conn_stats["open"] -= 1
-            if self.conn_slots is not None:
-                self.conn_slots.release()
-        super().finish()
+_STATUS_LINES = {
+    200: b"HTTP/1.1 200 OK\r\n",
+    400: b"HTTP/1.1 400 Bad Request\r\n",
+    403: b"HTTP/1.1 403 Forbidden\r\n",
+    404: b"HTTP/1.1 404 Not Found\r\n",
+    413: b"HTTP/1.1 413 Content Too Large\r\n",
+    429: b"HTTP/1.1 429 Too Many Requests\r\n",
+    431: b"HTTP/1.1 431 Request Header Fields Too Large\r\n",
+    500: b"HTTP/1.1 500 Internal Server Error\r\n",
+    501: b"HTTP/1.1 501 Not Implemented\r\n",
+    503: b"HTTP/1.1 503 Service Unavailable\r\n",
+}
 
-    def do_GET(self) -> None:  # noqa: N802 — stdlib API
+
+class _Request:
+    """One parsed request head (the loop never buffers request bodies)."""
+
+    __slots__ = ("method", "target", "headers", "keep_alive")
+
+    def __init__(self, method: str, target: str, headers: dict[str, str],
+                 keep_alive: bool) -> None:
+        self.method = method
+        self.target = target
+        self.headers = headers
+        self.keep_alive = keep_alive
+
+
+def _parse_head(head: bytes) -> _Request | None:
+    """Parse request line + headers. None = malformed (caller 400s)."""
+    lines = head.split(b"\r\n")
+    parts = lines[0].split()
+    if len(parts) != 3:
+        return None
+    method_b, target_b, version = parts
+    if not version.startswith(b"HTTP/1."):
+        return None
+    headers: dict[str, str] = {}
+    for line in lines[1:]:
+        if not line:
+            continue
+        key, sep, value = line.partition(b":")
+        if not sep:
+            return None
         try:
-            self._route_get()
-        except (TimeoutError, BlockingIOError) as e:
-            # SO_SNDTIMEO fired mid-response: the client stalled reading.
-            # Count it, kill the (half-written) connection, swallow — the
-            # stdlib would otherwise stack-trace a client-side fault.
-            if self.write_timeouts is not None:
-                with self.write_timeouts_lock:
-                    self.write_timeouts["total"] += 1
-            self.close_connection = True
-            log.debug("client write timeout from %s: %s",
-                      self.client_address[0], e)
+            headers[key.strip().decode("latin-1").lower()] = (
+                value.strip().decode("latin-1")
+            )
+        except UnicodeDecodeError:  # pragma: no cover - latin-1 total
+            return None
+    keep_alive = version == b"HTTP/1.1"
+    conn_tokens = headers.get("connection", "").lower()
+    if "close" in conn_tokens:
+        keep_alive = False
+    elif "keep-alive" in conn_tokens:
+        keep_alive = True
+    return _Request(method_b.decode("latin-1"), target_b.decode("latin-1"),
+                    headers, keep_alive)
 
-    def _route_get(self) -> None:
-        path, _, query = self.path.partition("?")
+
+class _Response:
+    """A response the loop serializes and writes. ``observe`` marks a
+    served scrape (duration observed + trace span recorded at flush)."""
+
+    __slots__ = ("status", "headers", "body", "close", "observe",
+                 "trace_ctx")
+
+    def __init__(self, status: int, headers: list[tuple[str, str]],
+                 body: bytes, close: bool = False, observe: bool = False,
+                 trace_ctx: tuple[str, str] | None = None) -> None:
+        self.status = status
+        self.headers = headers
+        self.body = body
+        self.close = close
+        self.observe = observe
+        self.trace_ctx = trace_ctx
+
+
+def _text_response(code: int, body: bytes, close: bool = False) -> _Response:
+    return _Response(
+        code, [("Content-Type", "text/plain; charset=utf-8")], body,
+        close=close,
+    )
+
+
+def _json_response(code: int, obj: Any) -> _Response:
+    try:
+        # allow_nan=False: bare NaN/Infinity literals are not JSON and
+        # break every strict parser (jq, JSON.parse, encoding/json) —
+        # exactly during the forensics these endpoints serve. Backends
+        # CAN report NaN samples (format_value supports them), so the
+        # fallback path maps non-finite values to null instead of 500ing.
+        body = json.dumps(obj, allow_nan=False).encode()
+    except ValueError:
+        body = json.dumps(_json_sanitize(obj)).encode()
+    return _Response(code, [("Content-Type", "application/json")], body)
+
+
+class _Conn:
+    """Per-connection loop state: read buffer, pending write queue, and the
+    bookkeeping the admission/observation paths need at request finish."""
+
+    __slots__ = (
+        "sock", "fd", "ip", "rbuf", "wbufs", "admitted", "keep_alive",
+        "busy", "close_after", "closed", "client_key", "req_t0",
+        "observe_scrape", "trace_ctx", "need_discard", "events",
+        "response_pending", "last_write_progress", "write_deadline_armed",
+    )
+
+    def __init__(self, sock: socket.socket, ip: str) -> None:
+        self.sock = sock
+        self.fd = sock.fileno()
+        self.ip = ip
+        self.rbuf = bytearray()
+        self.wbufs: deque[memoryview] = deque()
+        self.admitted = True
+        self.keep_alive = True
+        self.busy = False            # a request is in flight
+        self.close_after = False
+        self.closed = False
+        self.client_key: str | None = None
+        self.req_t0 = 0.0
+        self.observe_scrape = False
+        self.trace_ctx: tuple[str, str] | None = None
+        self.need_discard = 0        # request-body bytes left to drop
+        self.events = 0              # current selector interest mask
+        self.response_pending = False
+        self.last_write_progress = 0.0
+        self.write_deadline_armed = False
+
+
+class _WorkerPool:
+    """Elastic thread pool for request work that may block (uncached
+    renders, history/fleet queries, /debug serialization). Threads are
+    spawned on demand up to ``max_workers`` and expire after idling — the
+    steady state of a healthy exporter is zero to one worker, because the
+    hot path never leaves the loop."""
+
+    _IDLE_EXPIRE_S = 10.0
+
+    def __init__(self, max_workers: int) -> None:
+        self._max = max(1, max_workers)
+        self._tasks: deque[Callable[[], None]] = deque()
+        self._cv = threading.Condition(threading.Lock())
+        self._threads = 0
+        self._idle = 0
+        self._seq = 0
+        self._stopping = False
+
+    @property
+    def threads(self) -> int:
+        return self._threads
+
+    @property
+    def queued(self) -> int:
+        return len(self._tasks)
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        with self._cv:
+            if self._stopping:
+                return
+            self._tasks.append(fn)
+            # Spawn when the backlog exceeds the idle workers, not only
+            # when none are idle: a batch of submits landing while one
+            # worker is still in cv.wait would otherwise issue lost
+            # notify()s (one waiter absorbs one notify) and serialize the
+            # whole batch onto that single thread despite pool capacity.
+            if self._idle < len(self._tasks) and self._threads < self._max:
+                self._threads += 1
+                self._seq += 1
+                t = threading.Thread(
+                    target=self._run,
+                    name=f"tpu-exporter-http-worker-{self._seq}",
+                    daemon=True,
+                )
+                t.start()
+            self._cv.notify()
+
+    def _run(self) -> None:
+        while True:
+            with self._cv:
+                self._idle += 1
+                while not self._tasks and not self._stopping:
+                    if not self._cv.wait(timeout=self._IDLE_EXPIRE_S):
+                        if self._tasks or self._stopping:
+                            break
+                        self._idle -= 1
+                        self._threads -= 1
+                        return
+                self._idle -= 1
+                if not self._tasks:
+                    self._threads -= 1
+                    return
+                fn = self._tasks.popleft()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a task must not kill the pool
+                log.exception("http worker task failed")
+
+    def shutdown(self) -> None:
+        with self._cv:
+            self._stopping = True
+            self._cv.notify_all()
+
+
+class _HandlerState:
+    """Every knob and shared counter the request paths read.
+
+    Exposed to tests as ``server._httpd.RequestHandlerClass`` — the
+    pre-event-loop server bound these as class attributes on a
+    per-instance handler subclass, and the admission/fence tests poke
+    them (``client_active``, ``client_lock``, ``api_sem``) directly."""
+
+    def __init__(self) -> None:
+        self.store: SnapshotStore = None  # type: ignore[assignment]
+        self.debug_vars: Callable[[], dict] | None = None
+        self.history: Any = None
+        self.fleet: Any = None
+        self.trace: Any = None
+        self.api_sem: threading.BoundedSemaphore | None = None
+        self.api_queue_timeout_s: float = 0.25
+        self.debug_addr: str = "127.0.0.1"
+        self.health_max_age_s: float = 0.0
+        self.live_fn: Callable[[], str | None] | None = None
+        self.ready_detail_fn: Callable[[], dict] | None = None
+        self.warm_fn: Callable[[], dict | None] | None = None
+        self.scrape_sem: threading.BoundedSemaphore | None = None
+        self.scrape_queue_timeout_s: float = 0.25
+        self.scrape_bucket: _TokenBucket | None = None
+        self.scrape_tarpit_s: float = 0.1
+        self.scrape_rejects: dict[str, int] = {}
+        self.scrape_rejects_lock = threading.Lock()
+        self.scrape_observer: Callable[[float], None] | None = None
+        self.max_open_connections: int = 0
+        self.conn_stats: dict[str, int] = {}
+        self.conn_lock = threading.Lock()
+        self.max_requests_per_client: int = 0
+        self.client_active: dict[str, int] = {}
+        self.client_lock = threading.Lock()
+        self.client_write_timeout_s: float = 10.0
+        self.write_timeouts: dict[str, int] = {}
+        self.write_timeouts_lock = threading.Lock()
+
+
+class _CompatHandle:
+    """Legacy introspection shim: tests (and only tests) reach the shared
+    handler state through ``server._httpd.RequestHandlerClass``, the path
+    the stdlib-server implementation exposed."""
+
+    def __init__(self, state: _HandlerState) -> None:
+        self.RequestHandlerClass = state
+
+
+class _EventLoopServer:
+    """The selector loop plus request routing. Single-threaded: every
+    socket operation happens on the loop thread; workers communicate back
+    exclusively through :meth:`call_soon` + the wake pipe."""
+
+    def __init__(self, host: str, port: int, state: _HandlerState,
+                 max_workers: int) -> None:
+        self.state = state
+        self._sel = selectors.DefaultSelector()
+        lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        # SO_REUSEADDR (TIME_WAIT rebinds) but never SO_REUSEPORT: a second
+        # exporter instance binding the same live port must fail loudly,
+        # not silently steal scrapes.
+        lsock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        try:
+            lsock.bind((host, port))
+        except OSError:
+            lsock.close()
+            raise
+        lsock.listen(128)
+        lsock.setblocking(False)
+        self._lsock = lsock
+        # Cached: the port must stay readable after close() (stop() then
+        # a late .port read must not raise on the dead socket).
+        self._port = int(lsock.getsockname()[1])
+        self._conns: dict[int, _Conn] = {}
+        self._timers: list[tuple[float, int, Callable[[], None]]] = []
+        self._timer_seq = 0
+        self._pending: deque[Callable[[], None]] = deque()
+        self._pending_lock = threading.Lock()
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._wake_w.setblocking(False)
+        self._stopping = False
+        self.pool = _WorkerPool(max_workers)
+        self.served = {"inline": 0, "worker": 0}
+        self._sel.register(lsock, selectors.EVENT_READ, None)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, None)
+
+    @property
+    def port(self) -> int:
+        return self._port
+
+    # ------------------------------------------------------------ loop core
+
+    def run(self) -> None:
+        try:
+            while not self._stopping:
+                timeout: float | None = None
+                if self._timers:
+                    timeout = max(0.0, self._timers[0][0] - time.monotonic())
+                for key, mask in self._sel.select(timeout):
+                    if key.fileobj is self._lsock:
+                        self._accept()
+                    elif key.fileobj is self._wake_r:
+                        self._drain_wake()
+                    else:
+                        conn: _Conn = key.data
+                        if conn.closed:
+                            continue
+                        if mask & selectors.EVENT_WRITE:
+                            self._try_write(conn)
+                        if mask & selectors.EVENT_READ and not conn.closed:
+                            self._on_readable(conn)
+                self._run_pending()
+                self._run_timers()
+        finally:
+            for conn in list(self._conns.values()):
+                self._close_conn(conn)
+            self.pool.shutdown()
+            try:
+                self._sel.unregister(self._lsock)
+                self._sel.unregister(self._wake_r)
+            except (KeyError, ValueError):
+                pass
+            self._sel.close()
+            self._wake_r.close()
+            self._wake_w.close()
+            self._lsock.close()
+
+    def wake(self) -> None:
+        try:
+            self._wake_w.send(b"\x00")
+        except (BlockingIOError, OSError):
+            pass  # a wake is already pending (or the loop is gone)
+
+    def call_soon(self, fn: Callable[[], None]) -> None:
+        """Thread-safe: schedule ``fn`` on the loop thread."""
+        with self._pending_lock:
+            self._pending.append(fn)
+        self.wake()
+
+    def call_later(self, delay_s: float, fn: Callable[[], None]) -> None:
+        """Loop-thread only: run ``fn`` after ``delay_s``."""
+        self._timer_seq += 1
+        heapq.heappush(
+            self._timers, (time.monotonic() + delay_s, self._timer_seq, fn)
+        )
+
+    def _drain_wake(self) -> None:
+        try:
+            while self._wake_r.recv(4096):
+                pass
+        except (BlockingIOError, OSError):
+            pass
+
+    def _run_pending(self) -> None:
+        while True:
+            with self._pending_lock:
+                if not self._pending:
+                    return
+                fn = self._pending.popleft()
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one callback must not kill the loop
+                log.exception("loop callback failed")
+
+    def _run_timers(self) -> None:
+        now = time.monotonic()
+        while self._timers and self._timers[0][0] <= now:
+            _, _, fn = heapq.heappop(self._timers)
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — one timer must not kill the loop
+                log.exception("loop timer failed")
+
+    # ----------------------------------------------------- connection state
+
+    def _set_events(self, conn: _Conn, events: int) -> None:
+        if conn.closed or events == conn.events:
+            return
+        if events == 0:
+            self._sel.unregister(conn.sock)
+        elif conn.events == 0:
+            self._sel.register(conn.sock, events, conn)
+        else:
+            self._sel.modify(conn.sock, events, conn)
+        conn.events = events
+
+    def _accept(self) -> None:
+        st = self.state
+        for _ in range(128):
+            try:
+                sock, addr = self._lsock.accept()
+            except (BlockingIOError, OSError):
+                return
+            sock.setblocking(False)
+            try:
+                sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            except OSError:
+                pass
+            conn = _Conn(sock, addr[0])
+            cap = st.max_open_connections
+            with st.conn_lock:
+                if cap > 0 and st.conn_stats["open"] >= cap:
+                    # Over the cap: the connection still gets ONE request
+                    # handled — a probe answer, or the pre-rendered 429 —
+                    # then closes. It is never counted as open.
+                    conn.admitted = False
+                else:
+                    st.conn_stats["open"] += 1
+                    if st.conn_stats["open"] > st.conn_stats["peak"]:
+                        st.conn_stats["peak"] = st.conn_stats["open"]
+            self._conns[conn.fd] = conn
+            self._set_events(conn, selectors.EVENT_READ)
+
+    def _close_conn(self, conn: _Conn) -> None:
+        if conn.closed:
+            return
+        try:
+            self._set_events(conn, 0)
+        except (KeyError, ValueError, OSError):
+            pass
+        conn.closed = True
+        self._release_client_slot(conn)
+        self._conns.pop(conn.fd, None)
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+        if conn.admitted:
+            with self.state.conn_lock:
+                self.state.conn_stats["open"] -= 1
+
+    def _release_client_slot(self, conn: _Conn) -> None:
+        key = conn.client_key
+        if key is None:
+            return
+        conn.client_key = None
+        st = self.state
+        with st.client_lock:
+            cur = st.client_active.get(key, 1) - 1
+            if cur <= 0:
+                st.client_active.pop(key, None)
+            else:
+                st.client_active[key] = cur
+
+    # --------------------------------------------------------------- reads
+
+    def _on_readable(self, conn: _Conn) -> None:
+        try:
+            data = conn.sock.recv(65536)
+        except BlockingIOError:
+            return
+        except OSError:
+            self._close_conn(conn)
+            return
+        if not data:
+            self._close_conn(conn)
+            return
+        conn.rbuf += data
+        if conn.busy:
+            if len(conn.rbuf) > 4 * _MAX_HEADER_BYTES:
+                # Pipelining flood while a response is in flight: stop
+                # reading until the current request finishes.
+                self._set_events(conn, conn.events & ~selectors.EVENT_READ)
+            return
+        self._process_rbuf(conn)
+
+    def _process_rbuf(self, conn: _Conn) -> None:
+        if conn.need_discard:
+            take = min(conn.need_discard, len(conn.rbuf))
+            del conn.rbuf[:take]
+            conn.need_discard -= take
+            if conn.need_discard:
+                return
+        idx = conn.rbuf.find(b"\r\n\r\n")
+        if idx < 0:
+            if len(conn.rbuf) > _MAX_HEADER_BYTES:
+                self._respond(conn, _text_response(
+                    431, b"request header too large\n", close=True))
+            return
+        head = bytes(conn.rbuf[:idx])
+        del conn.rbuf[:idx + 4]
+        req = _parse_head(head)
+        if req is None:
+            self._respond(conn, _text_response(
+                400, b"malformed request\n", close=True))
+            return
+        if "transfer-encoding" in req.headers:
+            self._respond(conn, _text_response(
+                400, b"request bodies are not accepted\n", close=True))
+            return
+        try:
+            body_len = int(req.headers.get("content-length", "0") or "0")
+        except ValueError:
+            self._respond(conn, _text_response(
+                400, b"bad content-length\n", close=True))
+            return
+        if body_len > _MAX_BODY_DISCARD:
+            self._respond(conn, _text_response(
+                413, b"request body too large\n", close=True))
+            return
+        if body_len > 0:
+            conn.need_discard = body_len
+            take = min(conn.need_discard, len(conn.rbuf))
+            del conn.rbuf[:take]
+            conn.need_discard -= take
+        conn.busy = True
+        conn.req_t0 = time.monotonic()
+        conn.keep_alive = req.keep_alive
+        if req.method != "GET":
+            self._respond(conn, _text_response(
+                501, b"only GET is supported\n", close=True))
+            return
+        try:
+            self._handle_request(conn, req)
+        except Exception:  # noqa: BLE001 — routing bug must not kill the loop
+            log.exception("request handling failed")
+            if not conn.closed and not conn.response_pending:
+                self._respond(conn, _text_response(
+                    500, b"internal error\n", close=True))
+
+    # -------------------------------------------------------------- writes
+
+    def _respond(self, conn: _Conn, resp: _Response) -> None:
+        if conn.closed:
+            return
+        body = resp.body
+        close = resp.close or not conn.keep_alive
+        head = [_STATUS_LINES[resp.status]]
+        for k, v in resp.headers:
+            head.append(f"{k}: {v}\r\n".encode("latin-1"))
+        head.append(b"Content-Length: " + str(len(body)).encode("ascii")
+                    + b"\r\n")
+        if close:
+            head.append(b"Connection: close\r\n")
+        head.append(b"\r\n")
+        conn.wbufs.append(memoryview(b"".join(head)))
+        if body:
+            conn.wbufs.append(memoryview(body))
+        conn.close_after = close
+        conn.observe_scrape = resp.observe
+        conn.trace_ctx = resp.trace_ctx
+        conn.response_pending = True
+        conn.last_write_progress = time.monotonic()
+        if close:
+            self._stop_reading(conn)
+        self._try_write(conn)
+
+    def _send_raw(self, conn: _Conn, raw: bytes) -> None:
+        """Queue pre-rendered wire bytes (the 429 family) and close after."""
+        if conn.closed:
+            return
+        conn.wbufs.append(memoryview(raw))
+        conn.close_after = True
+        conn.observe_scrape = False
+        conn.trace_ctx = None
+        conn.response_pending = True
+        conn.last_write_progress = time.monotonic()
+        self._stop_reading(conn)
+        self._try_write(conn)
+
+    def _stop_reading(self, conn: _Conn) -> None:
+        """This connection will close once its response flushes: stop
+        reading and drop any buffered client bytes. Without this a client
+        streaming header-less bytes (no terminator, never reading) would
+        grow ``rbuf`` at its send rate and queue one 431 per recv — an
+        unauthenticated memory lever — since the pipelining read-throttle
+        only engages while ``busy`` is set."""
+        conn.rbuf.clear()
+        conn.need_discard = 0
+        self._set_events(conn, conn.events & ~selectors.EVENT_READ)
+
+    def _try_write(self, conn: _Conn) -> None:
+        sock = conn.sock
+        while conn.wbufs:
+            mv = conn.wbufs[0]
+            try:
+                n = sock.send(mv)
+            except BlockingIOError:
+                self._set_events(conn, conn.events | selectors.EVENT_WRITE)
+                self._arm_write_deadline(conn)
+                return
+            except OSError:
+                self._close_conn(conn)
+                return
+            if n:
+                conn.last_write_progress = time.monotonic()
+            if n < len(mv):
+                conn.wbufs[0] = mv[n:]
+            else:
+                conn.wbufs.popleft()
+        if conn.events & selectors.EVENT_WRITE:
+            self._set_events(conn, conn.events & ~selectors.EVENT_WRITE)
+        if conn.response_pending:
+            conn.response_pending = False
+            self._finish_request(conn)
+
+    def _arm_write_deadline(self, conn: _Conn) -> None:
+        """Slow-client write defense: the old server set SO_SNDTIMEO so a
+        blocked sendall() raised after --client-write-timeout-s; on the
+        loop the same contract is a progress deadline — a connection whose
+        pending bytes move nothing for that long is dropped and counted
+        (tpu_exporter_client_write_timeouts_total)."""
+        t = self.state.client_write_timeout_s
+        if t <= 0 or conn.write_deadline_armed:
+            return
+        conn.write_deadline_armed = True
+
+        def check() -> None:
+            if conn.closed or not conn.wbufs:
+                conn.write_deadline_armed = False
+                return
+            idle = time.monotonic() - conn.last_write_progress
+            if idle >= t:
+                st = self.state
+                with st.write_timeouts_lock:
+                    st.write_timeouts["total"] += 1
+                log.debug("client write timeout from %s", conn.ip)
+                self._close_conn(conn)
+            else:
+                self.call_later(t - idle, check)
+
+        self.call_later(t, check)
+
+    def _finish_request(self, conn: _Conn) -> None:
+        if conn.observe_scrape:
+            dur = time.monotonic() - conn.req_t0
+            observer = self.state.scrape_observer
+            if observer is not None:
+                try:
+                    observer(dur)
+                except Exception:  # noqa: BLE001 — observer must not kill the loop
+                    log.exception("scrape observer failed")
+            ctx = conn.trace_ctx
+            tstore = self.state.trace
+            if ctx is not None and tstore is not None:
+                # Cross-tier join: a scrape carrying a W3C traceparent
+                # header (the aggregator stamps one per fan-out scrape)
+                # records a node-side scrape span under the REMOTE trace
+                # context, so the aggregator's round trace links to this
+                # exporter's serve time. Headerless scrapes (Prometheus)
+                # record nothing — no per-scrape ring churn.
+                tstore.record_scrape(
+                    ctx[0], ctx[1], time.time() - dur, dur, client=conn.ip,
+                )
+            conn.observe_scrape = False
+            conn.trace_ctx = None
+        self._release_client_slot(conn)
+        conn.busy = False
+        if conn.close_after:
+            self._close_conn(conn)
+            return
+        self._set_events(conn, conn.events | selectors.EVENT_READ)
+        if conn.rbuf or conn.need_discard:
+            # Deferred (not recursed): a client that pipelined hundreds of
+            # requests into one buffer must cost loop iterations, not
+            # Python stack depth.
+            self.call_soon(lambda: self._resume_buffered(conn))
+
+    def _resume_buffered(self, conn: _Conn) -> None:
+        if not conn.closed and not conn.busy:
+            self._process_rbuf(conn)
+
+    # ------------------------------------------------------------- routing
+
+    def _count_reject(self, cause: str) -> None:
+        st = self.state
+        # += on a dict value is a read-modify-write, NOT GIL-atomic; the
+        # worker reject paths share this counter with the loop (advisor r4).
+        with st.scrape_rejects_lock:
+            st.scrape_rejects[cause] = st.scrape_rejects.get(cause, 0) + 1
+
+    def _handle_request(self, conn: _Conn, req: _Request) -> None:
+        st = self.state
+        path, _, query = req.target.partition("?")
         exempt = path in _ADMISSION_EXEMPT_PATHS
-        if not getattr(self, "_admitted", True):
+        if not conn.admitted:
             # Over the connection cap: this connection never got a slot.
             # Probe paths still answer (then close); everything else gets
             # the pre-rendered 429 — the storm pays, kubelet never does.
-            self.close_connection = True
-            if not exempt:
-                self._count_admission_reject("connections")
-                self.wfile.write(_CONN_REJECT_RESPONSE)
-                return
-        cap = self.max_requests_per_client
-        client_key = None
+            if exempt:
+                resp = self._probe_response(path)
+                resp.close = True
+                self._respond(conn, resp)
+            else:
+                self._count_reject("connections")
+                self._send_raw(conn, _CONN_REJECT_RESPONSE)
+            return
+        cap = st.max_requests_per_client
         if cap > 0 and not exempt:
-            client_key = self.client_address[0]
-            with self.client_lock:
-                cur = self.client_active.get(client_key, 0)
-                if cur >= cap:
-                    client_key = None
-                    over = True
-                else:
-                    self.client_active[client_key] = cur + 1
-                    over = False
+            ip = conn.ip
+            with st.client_lock:
+                cur = st.client_active.get(ip, 0)
+                over = cur >= cap
+                if not over:
+                    st.client_active[ip] = cur + 1
             if over:
-                self._count_admission_reject("client")
-                self.close_connection = True
-                self.wfile.write(_CLIENT_REJECT_RESPONSE)
+                self._count_reject("client")
+                self._send_raw(conn, _CLIENT_REJECT_RESPONSE)
                 return
-        try:
-            self._dispatch_get(path, query)
-        finally:
-            if client_key is not None:
-                with self.client_lock:
-                    cur = self.client_active.get(client_key, 1) - 1
-                    if cur <= 0:
-                        self.client_active.pop(client_key, None)
-                    else:
-                        self.client_active[client_key] = cur
+            # Held until this request's response is flushed (or the
+            # connection dies) — the loop equivalent of the old handler
+            # thread occupying the slot for the handler's lifetime.
+            conn.client_key = ip
+        self._dispatch(conn, req, path, query)
 
-    def _count_admission_reject(self, cause: str) -> None:
-        if self.scrape_rejects is not None:
-            with self.scrape_rejects_lock:
-                self.scrape_rejects[cause] = (
-                    self.scrape_rejects.get(cause, 0) + 1
-                )
-
-    def _dispatch_get(self, path: str, query: str) -> None:
+    def _dispatch(self, conn: _Conn, req: _Request, path: str,
+                  query: str) -> None:
+        st = self.state
         if path == "/metrics":
-            self._serve_metrics()
+            self._handle_metrics(conn, req)
         elif path.startswith("/api/v1/"):
-            self._serve_api(path, query)
+            self._defer(conn, lambda: self._task_api(conn, req, path, query))
         elif path.startswith("/debug/") and not debug_client_allowed(
-            self.client_address[0], self.debug_addr
+            conn.ip, st.debug_addr
         ):
             # Loopback-only by default: stacks + effective config are
             # operator surface. --debug-addr 0.0.0.0 restores remote reads.
-            self._serve_text(
+            self._respond(conn, _text_response(
                 403, b"debug endpoints are loopback-only "
-                     b"(start with --debug-addr 0.0.0.0 to expose)\n"
-            )
-        elif path == "/debug/vars" and self.debug_vars is not None:
-            try:
-                body = json.dumps(type(self).debug_vars(), indent=1).encode()
-            except Exception as e:  # noqa: BLE001 — debug must not 500 loops
-                body = json.dumps({"error": str(e)}).encode()
-            self.send_response(200)
-            self.send_header("Content-Type", "application/json")
-            self.send_header("Content-Length", str(len(body)))
-            self.end_headers()
-            self.wfile.write(body)
+                     b"(start with --debug-addr 0.0.0.0 to expose)\n"))
+        elif path == "/debug/vars" and st.debug_vars is not None:
+            self._defer(conn, lambda: self._task_debug_vars(conn))
         elif path == "/debug/trace":
-            # Poll traces as Chrome trace_event JSON (chrome://tracing /
-            # Perfetto). Loopback-gated by the /debug/* guard above.
-            # Lock discipline (satellite audit, all /debug/* + /api/v1
-            # routes): every store-backed route copies references/values
-            # under the store's lock and serializes OUTSIDE it —
-            # TraceStore.last/scrapes here, _rows_for for /api/v1, the
-            # debug_vars callable's stats() snapshots — so a slow client
-            # draining a large JSON body can never hold a lock the poll
-            # thread needs for its snapshot swap or history/trace append.
-            self._serve_trace(query)
+            self._defer(conn, lambda: self._task_trace(conn, query))
         elif path == "/debug/stacks":
             # The pprof-equivalent SURVEY §5 asks for, sized to this
             # process: a point-in-time dump of every thread's Python stack.
             # THE tool for the wedge /healthz detects — `curl
             # /debug/stacks` from the node shows exactly where a stuck
             # poll thread is blocked (a hung gRPC call, a dead NFS mount)
-            # without kubectl exec, a debugger, or signals. Read-only,
-            # allocation-light, served even while the poll thread is
-            # wedged because handlers run on their own threads.
-            self._serve_text(200, _format_stacks().encode())
-        elif path == "/healthz":
-            reason = None
-            if self.live_fn is not None:
-                try:
-                    reason = type(self).live_fn()
-                except Exception as e:  # noqa: BLE001 — a broken hook is itself unhealthy
-                    reason = f"liveness hook failed: {e}"
-            snap = self.store.current()
-            if reason:
-                self._serve_text(503, f"{reason}\n".encode())
-            elif (
-                self.health_max_age_s > 0
-                and snap.timestamp > 0
-                and time.time() - snap.timestamp > self.health_max_age_s
-            ):
-                age = time.time() - snap.timestamp
-                self._serve_text(
-                    503, f"poll stalled: last snapshot {age:.1f}s old\n".encode()
-                )
-            else:
-                self._serve_text(200, b"ok\n")
-        elif path == "/readyz":
-            snap = self.store.current()
-            ready = snap.timestamp > 0
-            body: dict = {"ready": ready}
-            warm = None
-            if ready and self.warm_fn is not None:
-                try:
-                    warm = type(self).warm_fn()
-                except Exception:  # noqa: BLE001 — warm detail must not break probes
-                    warm = None
-            if not ready:
-                body["state"] = "starting"
-                body["reason"] = "no poll completed yet"
-            elif warm is not None:
-                # Serving the restored pre-restart snapshot; no live poll
-                # yet. Still 200 — data IS being served (that is the whole
-                # point of warm start) — but distinctly labeled so rollouts
-                # and operators can tell restored from live.
-                body["state"] = "warm"
-                body.update(warm)
-            else:
-                body["state"] = "ready"
-            if self.ready_detail_fn is not None:
-                try:
-                    detail = type(self).ready_detail_fn() or {}
-                    body.update(detail)
-                    # Degraded = still serving, but an operator should
-                    # look: a source breaker stuck open across probes, or
-                    # the egress receiver unreachable past the same reopen
-                    # threshold (batches buffering to disk, not flowing).
-                    egress = detail.get("egress") or {}
-                    if body["state"] == "ready" and (
-                        detail.get("degraded_sources")
-                        or egress.get("degraded")
-                    ):
-                        body["state"] = "degraded"
-                except Exception:  # noqa: BLE001 — detail must not break probes
-                    pass
-            # JSON either way (kubelet only reads the status code; humans
-            # and the RUNBOOK read the state + degraded-source detail).
-            self._serve_json(200 if ready else 503, body)
+            # without kubectl exec, a debugger, or signals. Served from a
+            # worker thread, so it renders even while the poll thread (or
+            # a render) is wedged.
+            self._defer(conn, lambda: self._task_stacks(conn))
+        elif path in _ADMISSION_EXEMPT_PATHS:
+            self._respond(conn, self._probe_response(path))
         elif path == "/":
-            self._serve_text(
+            self._respond(conn, _text_response(
                 200,
                 b"tpu-pod-exporter\n/metrics /healthz /readyz "
                 b"/api/v1/series /api/v1/query_range /api/v1/window_stats\n",
+            ))
+        else:
+            self._respond(conn, _text_response(404, b"not found\n"))
+
+    def _defer(self, conn: _Conn, fn: Callable[[], None]) -> None:
+        self.served["worker"] += 1
+
+        def run() -> None:
+            try:
+                fn()
+            except Exception:  # noqa: BLE001 — a task bug must still answer
+                log.exception("worker request task failed")
+                # Without this the client hangs until its own timeout and
+                # the keep-alive connection is wedged forever (busy never
+                # clears). Scheduled AFTER any response the task itself
+                # posted (call_soon is FIFO), so the guard below can tell
+                # "no response ever sent" from "response already in
+                # flight/flushed".
+                def fail() -> None:
+                    if conn.closed or conn.response_pending or not conn.busy:
+                        return
+                    self._respond(conn, _text_response(
+                        500, b"internal error\n", close=True))
+                self.call_soon(fail)
+
+        self.pool.submit(run)
+
+    def post_response(self, conn: _Conn, resp: _Response) -> None:
+        """Worker-side: hand a finished response to the loop for writing."""
+        self.call_soon(lambda: self._respond(conn, resp))
+
+    def post_raw(self, conn: _Conn, raw: bytes) -> None:
+        self.call_soon(lambda: self._send_raw(conn, raw))
+
+    # ------------------------------------------------------------- /metrics
+
+    def _handle_metrics(self, conn: _Conn, req: _Request) -> None:
+        st = self.state
+        bucket = st.scrape_bucket
+        if bucket is not None and not bucket.take():
+            self._count_reject("rate")
+            if st.scrape_tarpit_s > 0:
+                # Rate-cap rejects answer late: a fast 429 just makes a
+                # storming client retry faster. On the loop the tarpit is
+                # a timer — zero threads parked, however wide the storm.
+                self.call_later(
+                    st.scrape_tarpit_s,
+                    lambda: self._send_raw(conn, _REJECT_RESPONSE),
+                )
+            else:
+                self._send_raw(conn, _REJECT_RESPONSE)
+            return
+        sem = st.scrape_sem
+        if sem is not None and not sem.acquire(blocking=False):
+            # Contended: queue briefly on a worker with the old timeout
+            # semantics (429 + token refund when the wait expires).
+            self._defer(conn, lambda: self._task_metrics_queued(conn, req))
+            return
+        # Permit held (or no fence). Fast path: a body already rendered for
+        # this (format, encoding) pair is served inline — one cached-bytes
+        # lookup, no worker handoff, no blocking anywhere.
+        snap = st.store.current()
+        openmetrics = accepts_openmetrics(req.headers.get("accept", ""))
+        gzipped = "gzip" in req.headers.get("accept-encoding", "")
+        cached = getattr(snap, "cached_exposition", None)
+        body = cached(openmetrics, gzipped) if cached is not None else None
+        if body is not None:
+            self.served["inline"] += 1
+            if sem is not None:
+                sem.release()
+            self._respond(conn, self._metrics_response(
+                req, body, openmetrics, gzipped))
+            return
+        # Uncached (first scrape of a fresh encoding, or a store whose
+        # snapshots render lazily): the render may block — worker, with
+        # the already-held permit transferred.
+        self._defer(
+            conn, lambda: self._task_metrics_render(conn, req, sem),
+        )
+
+    def _metrics_response(self, req: _Request, body: bytes,
+                          openmetrics: bool, gzipped: bool) -> _Response:
+        headers = [(
+            "Content-Type",
+            OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE,
+        )]
+        if gzipped:
+            headers.append(("Content-Encoding", "gzip"))
+        ctx = None
+        if self.state.trace is not None:
+            ctx = parse_traceparent(req.headers.get("traceparent", ""))
+        return _Response(200, headers, body, observe=True, trace_ctx=ctx)
+
+    def _task_metrics_queued(self, conn: _Conn, req: _Request) -> None:
+        st = self.state
+        sem = st.scrape_sem
+        assert sem is not None
+        if not sem.acquire(timeout=st.scrape_queue_timeout_s):
+            if st.scrape_bucket is not None:
+                st.scrape_bucket.refund()  # this scrape was never served
+            # No tarpit here: this path already queued for
+            # scrape_queue_timeout_s, which throttles the client the same
+            # way.
+            self._count_reject("concurrency")
+            self.post_raw(conn, _REJECT_RESPONSE)
+            return
+        try:
+            self._render_metrics(conn, req)
+        finally:
+            sem.release()
+
+    def _task_metrics_render(self, conn: _Conn, req: _Request,
+                             sem: threading.BoundedSemaphore | None) -> None:
+        try:
+            self._render_metrics(conn, req)
+        finally:
+            if sem is not None:
+                sem.release()
+
+    def _render_metrics(self, conn: _Conn, req: _Request) -> None:
+        snap = self.state.store.current()
+        # Content negotiation: Prometheus ≥2.5 advertises OpenMetrics in
+        # Accept; both formats are served from cached bytes, so the
+        # negotiation costs a header parse, not a render.
+        openmetrics = accepts_openmetrics(req.headers.get("accept", ""))
+        gzipped = "gzip" in req.headers.get("accept-encoding", "")
+        if gzipped:
+            body = (
+                snap.encode_openmetrics_gzip() if openmetrics
+                else snap.encode_gzip()
             )
         else:
-            self._serve_text(404, b"not found\n")
+            body = snap.encode_openmetrics() if openmetrics else snap.encode()
+        self.post_response(conn, self._metrics_response(
+            req, body, openmetrics, gzipped))
 
-    # --------------------------------------------------------- trace export
+    # --------------------------------------------------------------- probes
+
+    def _probe_response(self, path: str) -> _Response:
+        if path == "/healthz":
+            return self._healthz_response()
+        return self._readyz_response()
+
+    def _healthz_response(self) -> _Response:
+        st = self.state
+        reason = None
+        if st.live_fn is not None:
+            try:
+                reason = st.live_fn()
+            except Exception as e:  # noqa: BLE001 — a broken hook is itself unhealthy
+                reason = f"liveness hook failed: {e}"
+        snap = st.store.current()
+        if reason:
+            return _text_response(503, f"{reason}\n".encode())
+        if (
+            st.health_max_age_s > 0
+            and snap.timestamp > 0
+            and time.time() - snap.timestamp > st.health_max_age_s
+        ):
+            age = time.time() - snap.timestamp
+            return _text_response(
+                503, f"poll stalled: last snapshot {age:.1f}s old\n".encode()
+            )
+        return _text_response(200, b"ok\n")
+
+    def _readyz_response(self) -> _Response:
+        st = self.state
+        snap = st.store.current()
+        ready = snap.timestamp > 0
+        body: dict = {"ready": ready}
+        warm = None
+        if ready and st.warm_fn is not None:
+            try:
+                warm = st.warm_fn()
+            except Exception:  # noqa: BLE001 — warm detail must not break probes
+                warm = None
+        if not ready:
+            body["state"] = "starting"
+            body["reason"] = "no poll completed yet"
+        elif warm is not None:
+            # Serving the restored pre-restart snapshot; no live poll
+            # yet. Still 200 — data IS being served (that is the whole
+            # point of warm start) — but distinctly labeled so rollouts
+            # and operators can tell restored from live.
+            body["state"] = "warm"
+            body.update(warm)
+        else:
+            body["state"] = "ready"
+        if st.ready_detail_fn is not None:
+            try:
+                detail = st.ready_detail_fn() or {}
+                body.update(detail)
+                # Degraded = still serving, but an operator should
+                # look: a source breaker stuck open across probes, or
+                # the egress receiver unreachable past the same reopen
+                # threshold (batches buffering to disk, not flowing).
+                egress = detail.get("egress") or {}
+                if body["state"] == "ready" and (
+                    detail.get("degraded_sources")
+                    or egress.get("degraded")
+                ):
+                    body["state"] = "degraded"
+            except Exception:  # noqa: BLE001 — detail must not break probes
+                pass
+        # JSON either way (kubelet only reads the status code; humans
+        # and the RUNBOOK read the state + degraded-source detail).
+        return _json_response(200 if ready else 503, body)
+
+    # ------------------------------------------------------------ /debug/*
+
+    def _task_debug_vars(self, conn: _Conn) -> None:
+        st = self.state
+        assert st.debug_vars is not None
+        try:
+            body = json.dumps(st.debug_vars(), indent=1).encode()
+        except Exception as e:  # noqa: BLE001 — debug must not 500 loops
+            body = json.dumps({"error": str(e)}).encode()
+        self.post_response(conn, _Response(
+            200, [("Content-Type", "application/json")], body))
+
+    def _task_stacks(self, conn: _Conn) -> None:
+        self.post_response(conn, _text_response(200, _format_stacks().encode()))
 
     # /debug/trace response bound: `last` is clamped so the export stays a
     # bounded handful of MB no matter what a client asks for (each trace is
     # ~8 spans; scrape spans are capped by their own ring).
     TRACE_EXPORT_MAX_LAST = 200
 
-    def _serve_trace(self, query: str) -> None:
-        ts = self.trace
+    def _task_trace(self, conn: _Conn, query: str) -> None:
+        ts = self.state.trace
         if ts is None:
-            self._serve_json(404, {
+            self.post_response(conn, _json_response(404, {
                 "status": "error",
                 "error": "tracing disabled (--trace off)",
-            })
+            }))
             return
         qs = parse_qs(query, keep_blank_values=True)
         try:
             last = int((qs.get("last") or ["20"])[-1])
         except ValueError:
-            self._serve_json(400, {
+            self.post_response(conn, _json_response(400, {
                 "status": "error", "error": "last must be an integer",
-            })
+            }))
             return
         if last < 1:
-            self._serve_json(400, {
+            self.post_response(conn, _json_response(400, {
                 "status": "error", "error": "last must be >= 1",
-            })
+            }))
             return
         last = min(last, self.TRACE_EXPORT_MAX_LAST)
         # Copy references under the store lock; build + serialize the (much
-        # larger) JSON document outside it (see the /debug/* lock audit).
+        # larger) JSON document on this worker — never on the loop, never
+        # under the store lock (the /debug/* lock audit).
         traces = ts.last(last)
         scrapes = ts.scrapes(min(4 * last, 512))
-        self._serve_json(200, to_chrome_trace(traces, scrapes))
+        self.post_response(
+            conn, _json_response(200, to_chrome_trace(traces, scrapes)))
 
-    # ------------------------------------------------------- history queries
+    # ------------------------------------------------------------- /api/v1
 
-    def _serve_api(self, path: str, query: str) -> None:
+    def _task_api(self, conn: _Conn, req: _Request, path: str,
+                  query: str) -> None:
         """JSON query surface: node-local history flight recorder, or the
         aggregator's federated fleet query plane when one is attached.
         Outside the scrape fences (the aggregator's missed-round fallback
         must not compete with the very scrape storm it is working around)
         but behind its own small concurrency cap — the same 2-permit fence
-        and pre-rendered 429 + Retry-After on both exporter and aggregator."""
-        sem = self.api_sem
-        if sem is not None and not sem.acquire(timeout=self.api_queue_timeout_s):
-            self.close_connection = True
-            self.wfile.write(_API_REJECT_RESPONSE)
+        and pre-rendered 429 + Retry-After on both exporter and
+        aggregator."""
+        st = self.state
+        sem = st.api_sem
+        if sem is not None and not sem.acquire(timeout=st.api_queue_timeout_s):
+            self.post_raw(conn, _API_REJECT_RESPONSE)
             return
         try:
             t0 = time.perf_counter()
-            self._serve_api_inner(path, query)
-            tstore = self.trace
+            resp = self._api_response(path, query)
+            tstore = st.trace
             if tstore is not None:
                 # Same cross-tier join as /metrics: an /api/v1 request
                 # carrying a traceparent (the fleet query plane stamps one
                 # per fan-out leg) records this node's serve span under the
                 # remote query trace. Headerless queries record nothing.
-                ctx = parse_traceparent(self.headers.get("traceparent") or "")
+                ctx = parse_traceparent(req.headers.get("traceparent", ""))
                 if ctx is not None:
                     dur = time.perf_counter() - t0
                     tstore.record_scrape(
                         ctx[0], ctx[1], time.time() - dur, dur,
-                        client=self.client_address[0],
+                        client=conn.ip,
                     )
+            self.post_response(conn, resp)
         finally:
             if sem is not None:
                 sem.release()
 
     @staticmethod
-    def _parse_range_params(param) -> tuple[str, float, float, float, str]:
+    def _parse_range_params(
+        param: Callable[[str], str | None],
+    ) -> tuple[str, float, float, float, str]:
         """Validated query_range params — shared by the node-local and
         fleet routes so the 400 contract cannot drift between tiers."""
         metric = param("metric")
@@ -615,7 +1309,7 @@ class _Handler(BaseHTTPRequestHandler):
         # loop is O((end-start)/step) Python iterations, and this
         # endpoint is unauthenticated and exempt from the scrape
         # fences — start=0&step=1 (~1.7e9 points) or end=inf must
-        # be a 400, not a pinned handler thread. Cap matches
+        # be a 400, not a pinned worker thread. Cap matches
         # Prometheus's 11k resolution limit.
         if not (math.isfinite(start) and math.isfinite(end)
                 and math.isfinite(step)):
@@ -632,7 +1326,9 @@ class _Handler(BaseHTTPRequestHandler):
         return metric, start, end, step, agg
 
     @staticmethod
-    def _parse_window_params(param) -> tuple[str, float]:
+    def _parse_window_params(
+        param: Callable[[str], str | None],
+    ) -> tuple[str, float]:
         metric = param("metric")
         if not metric:
             raise ValueError("missing required parameter: metric")
@@ -641,57 +1337,53 @@ class _Handler(BaseHTTPRequestHandler):
             raise ValueError("window must be > 0")
         return metric, window
 
-    def _serve_api_inner(self, path: str, query: str) -> None:
+    def _api_response(self, path: str, query: str) -> _Response:
+        st = self.state
         qs = parse_qs(query, keep_blank_values=True)
 
-        def param(name: str, default: str | None = None) -> str | None:
+        def param(name: str) -> str | None:
             vals = qs.get(name)
-            return vals[-1] if vals else default
+            return vals[-1] if vals else None
 
         match = {
             k[len("match["):-1]: vs[-1]
             for k, vs in qs.items()
             if k.startswith("match[") and k.endswith("]") and len(k) > 7
         }
-        if self.fleet is not None:
-            self._serve_fleet_api(path, param, match)
-            return
+        if st.fleet is not None:
+            return self._fleet_api_response(path, param, match)
         if param("source"):
             # The node tier has no store: a ?source= knob that silently
             # does nothing would let an operator trust an answer that is
             # not what they asked for (same rule as the store-less
             # aggregator below).
-            self._serve_json(400, {
+            return _json_response(400, {
                 "status": "error",
                 "error": "source= requires a store-backed root "
                          "(no fleet store attached on this tier)",
             })
-            return
-        h = self.history
+        h = st.history
         if h is None:
-            self._serve_json(404, {
+            return _json_response(404, {
                 "status": "error",
                 "error": "history disabled (--history-retention-s 0)",
             })
-            return
         try:
             if path == "/api/v1/series":
-                self._serve_json(200, {"status": "ok", "source": "live",
-                                       "data": h.series_list()})
-                return
+                return _json_response(200, {"status": "ok", "source": "live",
+                                            "data": h.series_list()})
             if path == "/api/v1/query_range":
                 metric, start, end, step, agg = self._parse_range_params(
                     param)
                 result = h.query_range(metric, match, start, end, step,
                                        agg=agg)
                 if not result:
-                    self._serve_json(404, {
+                    return _json_response(404, {
                         "status": "error",
                         "error": f"no samples for metric {metric!r} "
                                  f"matching {match!r} in range",
                     })
-                    return
-                self._serve_json(200, {
+                return _json_response(200, {
                     "status": "ok",
                     # Shared envelope contract across tiers: node-local
                     # answers are "live" by definition (the root's
@@ -700,31 +1392,30 @@ class _Handler(BaseHTTPRequestHandler):
                     "source": "live",
                     "data": {"resultType": "matrix", "result": result},
                 })
-                return
             if path == "/api/v1/window_stats":
                 metric, window = self._parse_window_params(param)
                 result = h.window_stats(metric, match, window_s=window)
                 if not result:
-                    self._serve_json(404, {
+                    return _json_response(404, {
                         "status": "error",
                         "error": f"no samples for metric {metric!r} "
                                  f"matching {match!r} in window",
                     })
-                    return
-                self._serve_json(200, {"status": "ok", "source": "live",
-                                       "data": {"result": result}})
-                return
+                return _json_response(200, {"status": "ok", "source": "live",
+                                            "data": {"result": result}})
         except ValueError as e:
-            self._serve_json(400, {"status": "error", "error": str(e)})
-            return
-        self._serve_json(404, {"status": "error", "error": "unknown API path"})
+            return _json_response(400, {"status": "error", "error": str(e)})
+        return _json_response(404, {"status": "error",
+                                    "error": "unknown API path"})
 
-    def _serve_fleet_api(self, path: str, param, match: dict) -> None:
+    def _fleet_api_response(self, path: str,
+                            param: Callable[[str], str | None],
+                            match: dict) -> _Response:
         """Federated /api/v1 on the aggregator: same routes, same param
         validation, but the answer is the fleet envelope — merged series
         plus per-target status — and a dead target is partial=true, never
         a non-200 round failure."""
-        fleet = self.fleet
+        fleet = self.state.fleet
         # ?source=live|store|merged is meaningful only on a store-backed
         # plane (the root with --store-dir). Asking a store-less tier for
         # it must be an actionable 400, never a silently-ignored knob —
@@ -736,173 +1427,57 @@ class _Handler(BaseHTTPRequestHandler):
             if source:
                 kwargs["source"] = source
         elif source:
-            self._serve_json(400, {
+            return _json_response(400, {
                 "status": "error",
                 "error": "source= requires a store-backed root "
                          "(no fleet store attached on this tier)",
             })
-            return
         try:
             if path == "/api/v1/series":
-                self._serve_json(200, fleet.series(**kwargs))
-                return
+                return _json_response(200, fleet.series(**kwargs))
             if path == "/api/v1/query_range":
                 metric, start, end, step, agg = self._parse_range_params(
                     param)
-                self._serve_json(200, fleet.query_range(
+                return _json_response(200, fleet.query_range(
                     metric, match, start, end, step, agg=agg, **kwargs))
-                return
             if path == "/api/v1/window_stats":
                 metric, window = self._parse_window_params(param)
-                self._serve_json(200, fleet.window_stats(
+                return _json_response(200, fleet.window_stats(
                     metric, match, window_s=window, **kwargs))
-                return
         except ValueError as e:
-            self._serve_json(400, {"status": "error", "error": str(e)})
-            return
-        self._serve_json(404, {"status": "error", "error": "unknown API path"})
-
-    def _serve_json(self, code: int, obj) -> None:
-        try:
-            # allow_nan=False: bare NaN/Infinity literals are not JSON and
-            # break every strict parser (jq, JSON.parse, encoding/json) —
-            # exactly during the forensics these endpoints serve. Backends
-            # CAN report NaN samples (format_value supports them), so the
-            # fallback path maps non-finite values to null instead of 500ing.
-            body = json.dumps(obj, allow_nan=False).encode()
-        except ValueError:
-            body = json.dumps(_json_sanitize(obj)).encode()
-        self.send_response(code)
-        self.send_header("Content-Type", "application/json")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _serve_metrics(self) -> None:
-        bucket = self.scrape_bucket
-        if bucket is not None and not bucket.take():
-            self._reject_scrape("rate", tarpit=True)
-            return
-        sem = self.scrape_sem
-        if sem is not None and not sem.acquire(timeout=self.scrape_queue_timeout_s):
-            if bucket is not None:
-                bucket.refund()  # this scrape was never served
-            # No tarpit here: this path already queued for
-            # scrape_queue_timeout_s, which throttles the client the same way.
-            self._reject_scrape("concurrency")
-            return
-        try:
-            t0 = time.perf_counter()
-            self._serve_metrics_inner()
-            dur = time.perf_counter() - t0
-            observer = self.scrape_observer
-            if observer is not None:
-                observer(dur)
-            tstore = self.trace
-            if tstore is not None:
-                # Cross-tier join: a scrape carrying a W3C traceparent
-                # header (the aggregator stamps one per fan-out scrape)
-                # records a node-side scrape span under the REMOTE trace
-                # context, so the aggregator's round trace links to this
-                # exporter's serve time. Headerless scrapes (Prometheus)
-                # record nothing — no per-scrape ring churn.
-                ctx = parse_traceparent(self.headers.get("traceparent") or "")
-                if ctx is not None:
-                    tstore.record_scrape(
-                        ctx[0], ctx[1], time.time() - dur, dur,
-                        client=self.client_address[0],
-                    )
-        finally:
-            if sem is not None:
-                sem.release()
-
-    def _reject_scrape(self, cause: str, tarpit: bool = False) -> None:
-        if tarpit and self.scrape_tarpit_s > 0:
-            slots = self.tarpit_slots
-            if slots is not None and slots.acquire(blocking=False):
-                try:
-                    time.sleep(self.scrape_tarpit_s)
-                finally:
-                    slots.release()
-        if self.scrape_rejects is not None:
-            # += on a dict value is a read-modify-write, NOT GIL-atomic;
-            # under the very storm this counts, unlocked increments drop
-            # (advisor r4). The reject path is already slow-path — a
-            # lock costs nothing here.
-            with self.scrape_rejects_lock:
-                self.scrape_rejects[cause] += 1
-        self.close_connection = True
-        self.wfile.write(_REJECT_RESPONSE)
-
-    def _serve_metrics_inner(self) -> None:
-        snap = self.store.current()
-        # Content negotiation: Prometheus ≥2.5 advertises OpenMetrics in
-        # Accept; both formats are served from lazily-cached bytes, so the
-        # negotiation costs a header parse, not a render.
-        openmetrics = accepts_openmetrics(self.headers.get("Accept") or "")
-        headers = [
-            ("Content-Type", OPENMETRICS_CONTENT_TYPE if openmetrics else CONTENT_TYPE)
-        ]
-        if "gzip" in (self.headers.get("Accept-Encoding") or ""):
-            body = (
-                snap.encode_openmetrics_gzip() if openmetrics else snap.encode_gzip()
-            )  # compressed once per snapshot, cached
-            headers.append(("Content-Encoding", "gzip"))
-        else:
-            body = snap.encode_openmetrics() if openmetrics else snap.encode()
-        self.send_response(200)
-        for k, v in headers:
-            self.send_header(k, v)
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def _serve_text(self, code: int, body: bytes) -> None:
-        self.send_response(code)
-        self.send_header("Content-Type", "text/plain; charset=utf-8")
-        self.send_header("Content-Length", str(len(body)))
-        self.end_headers()
-        self.wfile.write(body)
-
-    def log_message(self, fmt: str, *args) -> None:  # quiet access logs
-        log.debug("http: " + fmt, *args)
-
-
-class _Server(ThreadingHTTPServer):
-    # Python ≥3.11 sets SO_REUSEPORT on ThreadingHTTPServer, which lets a
-    # second exporter instance bind the same port and silently steal scrapes.
-    # Fail loudly on a port conflict instead.
-    allow_reuse_port = False
-    daemon_threads = True
+            return _json_response(400, {"status": "error", "error": str(e)})
+        return _json_response(404, {"status": "error",
+                                    "error": "unknown API path"})
 
 
 class MetricsServer:
-    """Owns the listener thread. Unlike the reference (hardcoded ``:8000``,
-    ``log.Fatal`` on listener death, ``main.go:71``), port 0 is allowed for
-    tests (ephemeral) and shutdown is clean."""
+    """Owns the event loop thread. Unlike the reference (hardcoded
+    ``:8000``, ``log.Fatal`` on listener death, ``main.go:71``), port 0 is
+    allowed for tests (ephemeral) and shutdown is clean."""
 
     def __init__(
         self,
         store: SnapshotStore,
         host: str = "0.0.0.0",
         port: int = 8000,
-        debug_vars=None,
+        debug_vars: Callable[[], dict] | None = None,
         health_max_age_s: float = 0.0,
         max_concurrent_scrapes: int = 4,
         scrape_queue_timeout_s: float = 0.25,
         max_scrapes_per_s: float = 0.0,
         scrape_tarpit_s: float = 0.1,
-        scrape_observer=None,
-        history=None,
-        fleet=None,
-        trace=None,
+        scrape_observer: Callable[[float], None] | None = None,
+        history: Any = None,
+        fleet: Any = None,
+        trace: Any = None,
         debug_addr: str = "127.0.0.1",
-        live_fn=None,
-        ready_detail_fn=None,
+        live_fn: Callable[[], str | None] | None = None,
+        ready_detail_fn: Callable[[], dict] | None = None,
         client_write_timeout_s: float = 10.0,
-        warm_fn=None,
+        warm_fn: Callable[[], dict | None] | None = None,
         max_open_connections: int = 0,
         max_requests_per_client: int = 0,
+        max_workers: int = 8,
     ) -> None:
         # Every cause pre-seeded so the self-metric publishes a 0 series
         # per cause from poll 1 (stable surface). "connections"/"client"
@@ -914,85 +1489,89 @@ class MetricsServer:
         # Open-connection accounting for the admission cap (peak is the
         # scrape-storm drill's bound witness).
         self.conn_stats = {"open": 0, "peak": 0}
-        handler = type(
-            "BoundHandler",
-            (_Handler,),
-            {
-                "store": store,
-                "debug_vars": staticmethod(debug_vars) if debug_vars else None,
-                "history": history,
-                "fleet": fleet,
-                "trace": trace,
-                "api_sem": (
-                    threading.BoundedSemaphore(2)
-                    if history is not None or fleet is not None
-                    else None
-                ),
-                "debug_addr": debug_addr,
-                "health_max_age_s": health_max_age_s,
-                "live_fn": staticmethod(live_fn) if live_fn else None,
-                "ready_detail_fn": (
-                    staticmethod(ready_detail_fn) if ready_detail_fn else None
-                ),
-                "warm_fn": staticmethod(warm_fn) if warm_fn else None,
-                "client_write_timeout_s": client_write_timeout_s,
-                "write_timeouts": self.write_timeouts,
-                "write_timeouts_lock": threading.Lock(),
-                "scrape_sem": (
-                    threading.BoundedSemaphore(max_concurrent_scrapes)
-                    if max_concurrent_scrapes > 0
-                    else None
-                ),
-                "scrape_queue_timeout_s": scrape_queue_timeout_s,
-                # Burst 2× rate: absorbs scrape-alignment spikes (every
-                # scraper firing in the same second) without letting a
-                # sustained storm exceed ~rate serves/s.
-                "scrape_bucket": (
-                    _TokenBucket(max_scrapes_per_s, 2.0 * max_scrapes_per_s)
-                    if max_scrapes_per_s > 0
-                    else None
-                ),
-                "scrape_tarpit_s": scrape_tarpit_s,
-                "tarpit_slots": threading.BoundedSemaphore(64),
-                "scrape_rejects": self.scrape_rejects,
-                "scrape_rejects_lock": threading.Lock(),
-                "scrape_observer": (
-                    staticmethod(scrape_observer) if scrape_observer else None
-                ),
-                "conn_slots": (
-                    threading.BoundedSemaphore(max_open_connections)
-                    if max_open_connections > 0
-                    else None
-                ),
-                "conn_stats": self.conn_stats,
-                "conn_lock": threading.Lock(),
-                "max_requests_per_client": max_requests_per_client,
-                "client_active": {},
-                "client_lock": threading.Lock(),
-            },
+        state = _HandlerState()
+        state.store = store
+        state.debug_vars = debug_vars
+        state.history = history
+        state.fleet = fleet
+        state.trace = trace
+        state.api_sem = (
+            threading.BoundedSemaphore(2)
+            if history is not None or fleet is not None
+            else None
         )
-        self._httpd = _Server((host, port), handler)
+        state.debug_addr = debug_addr
+        state.health_max_age_s = health_max_age_s
+        state.live_fn = live_fn
+        state.ready_detail_fn = ready_detail_fn
+        state.warm_fn = warm_fn
+        state.client_write_timeout_s = client_write_timeout_s
+        state.write_timeouts = self.write_timeouts
+        state.scrape_sem = (
+            threading.BoundedSemaphore(max_concurrent_scrapes)
+            if max_concurrent_scrapes > 0
+            else None
+        )
+        state.scrape_queue_timeout_s = scrape_queue_timeout_s
+        # Burst 2× rate: absorbs scrape-alignment spikes (every scraper
+        # firing in the same second) without letting a sustained storm
+        # exceed ~rate serves/s.
+        state.scrape_bucket = (
+            _TokenBucket(max_scrapes_per_s, 2.0 * max_scrapes_per_s)
+            if max_scrapes_per_s > 0
+            else None
+        )
+        state.scrape_tarpit_s = scrape_tarpit_s
+        state.scrape_rejects = self.scrape_rejects
+        state.scrape_observer = scrape_observer
+        state.max_open_connections = max_open_connections
+        state.conn_stats = self.conn_stats
+        state.max_requests_per_client = max_requests_per_client
+        self._state = state
+        self._loop = _EventLoopServer(host, port, state, max_workers)
+        self._httpd = _CompatHandle(state)
         self._thread: threading.Thread | None = None
 
     @property
     def port(self) -> int:
-        return self._httpd.server_address[1]
+        return self._loop.port
+
+    def stats(self) -> dict[str, int]:
+        """Loop/pool counters for /debug/vars (RUNBOOK 'server')."""
+        loop = self._loop
+        return {
+            "open_connections": self.conn_stats["open"],
+            "peak_connections": self.conn_stats["peak"],
+            "write_timeouts": self.write_timeouts["total"],
+            "served_inline": loop.served["inline"],
+            # Counted at dispatch, not completion: includes requests the
+            # task itself later 429s (the /api/v1 fence) or fails with 500.
+            "worker_dispatched": loop.served["worker"],
+            "worker_threads": loop.pool.threads,
+            "worker_queue": loop.pool.queued,
+        }
 
     def start(self) -> None:
         if self._thread is not None:
             raise RuntimeError("already started")
         self._thread = threading.Thread(
-            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.1},
-            name="tpu-exporter-http", daemon=True,
+            target=self._loop.run, name="tpu-exporter-http", daemon=True,
         )
         self._thread.start()
 
     def stop(self) -> None:
+        loop = self._loop
         if self._thread is not None:
-            # shutdown() blocks until serve_forever acknowledges — calling it
-            # on a never-started server would deadlock, so gate on the thread.
-            self._httpd.shutdown()
-        self._httpd.server_close()
-        if self._thread is not None:
+            loop._stopping = True
+            loop.wake()
             self._thread.join(timeout=5.0)
             self._thread = None
+        else:
+            # Never started: release the port + selector resources without
+            # spinning the loop (stop-before-start must not deadlock).
+            loop._stopping = True
+            loop.pool.shutdown()
+            loop._sel.close()
+            loop._wake_r.close()
+            loop._wake_w.close()
+            loop._lsock.close()

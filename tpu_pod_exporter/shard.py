@@ -80,6 +80,7 @@ from tpu_pod_exporter.fleet import (
 from tpu_pod_exporter.metrics import (
     CounterStore,
     HistogramStore,
+    PrefixCache,
     SnapshotBuilder,
     SnapshotStore,
     schema,
@@ -657,6 +658,7 @@ class RootAggregator:
         breaker_store: Any = None,  # persist.BreakerStateFile | None
         stale_serve_s: float = 0.0,
         fleet_store: Any = None,  # store.FleetStore | None
+        render_splice: bool = True,  # --render-splice; RUNBOOK kill switch
     ) -> None:
         if not topology:
             raise ValueError("root needs at least one shard of leaves")
@@ -676,6 +678,10 @@ class RootAggregator:
         self._timeout_s = timeout_s
         self._fetch = fetch
         self._wallclock = wallclock
+        # Splice render across rounds (see SliceAggregator): the root's
+        # merged exposition re-renders only changed cells per round. Same
+        # kill switch as the other tiers (--render-splice false).
+        self._prefix_cache = PrefixCache(splice=render_splice)
         self._rlog = RateLimitedLogger(log)
         self._counters = CounterStore()
         # Stable conditional surface: both counters exist from round 1.
@@ -954,7 +960,7 @@ class RootAggregator:
     ) -> None:
         stale_served = stale_served or set()
         suspected = suspected or set()
-        b = SnapshotBuilder()
+        b = SnapshotBuilder(prefix_cache=self._prefix_cache)
         # Stable surface: fleet rollups + per-target passthrough + root
         # self-metrics, declared every round whether or not sampled.
         for spec in schema.AGGREGATE_SPECS:
@@ -1118,10 +1124,14 @@ class RootAggregator:
         return out
 
     def debug_vars(self) -> dict:
+        tmpl = self._prefix_cache.template
         return {
             "topology": {s: list(ls) for s, ls in self.topology.items()},
             "timeout_s": self._timeout_s,
             "rounds": self.rounds,
+            # Splice-render counters (None = --render-splice false); the
+            # RUNBOOK's render triage reads the same shape on every tier.
+            "render": tmpl.stats() if tmpl is not None else None,
             "store": (self._fleet_store.stats()
                       if self._fleet_store is not None else None),
             "stale_serve_s": self._stale_serve_s,
@@ -1436,6 +1446,12 @@ def _add_common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--timeout-s", type=float, default=2.0)
     p.add_argument("--debug-addr", default="127.0.0.1",
                    help="/debug/* exposure (same policy as the exporter)")
+    p.add_argument("--render-splice", default="on", choices=("on", "off"),
+                   help="incremental exposition render (splice changed "
+                        "cells into a pre-rendered body template per "
+                        "round); off restores the per-family full "
+                        "re-render — the RUNBOOK's bisection step, same "
+                        "switch as the exporter tier")
     p.add_argument("--state-dir", default="",
                    help="persist breaker + shard-map state here (atomic "
                         "JSON) so restarts keep quarantines and count real "
@@ -1584,6 +1600,7 @@ def _run_leaf(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         breaker_backoff_s=backoff,
         breaker_backoff_max_s=max(ns.breaker_backoff_max_s, backoff),
         breaker_store=breaker_store,
+        render_splice=ns.render_splice == "on",
     )
     from tpu_pod_exporter.fleet import FleetQueryPlane
 
@@ -1694,6 +1711,7 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         breaker_store=breaker_store,
         stale_serve_s=ns.stale_serve_s,
         fleet_store=fleet_store,
+        render_splice=ns.render_splice == "on",
     )
     plane: Any = None
     if ns.fleet_query == "on":
