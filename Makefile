@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo clean
+.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -28,12 +28,32 @@ typecheck:
 		echo "mypy not installed; skipped (CI runs it — pip install mypy)"; \
 	fi
 
-# Seed a deliberate lock-scoped json.dumps + an unregistered metric name
-# into a temp copy of collector.py and show exporter-lint catching both —
-# the lint analog of chaos-demo/trace-demo/restart-demo (exits non-zero
-# if a seeded violation slips through).
+# Seed one deliberate violation per rule family into a temp copy of the
+# package — a lock-scoped json.dumps, an unregistered metric name, a
+# lock-order inversion pair, and a wrong-thread WAL cursor move — and
+# require exporter-lint to catch ALL of them: the lint analog of
+# chaos-demo/trace-demo/restart-demo (exits non-zero if any seeded
+# violation slips through).
 lint-demo:
 	python -m tpu_pod_exporter.analysis --demo
+
+# Regenerate the REVIEWED lock-acquisition order graph artifacts
+# (README "Concurrency contracts"). deploy/lock-graph.json must match
+# the model byte-for-byte — tests/test_concurrency.py fails when it is
+# stale, so a diff here is a reviewable concurrency-structure change.
+lock-graph:
+	python -m tpu_pod_exporter.analysis \
+		--lock-graph deploy/lock-graph.json \
+		--lock-graph-dot deploy/lock-graph.dot
+
+# Run tier-1 under the runtime lock witness and cross-check the observed
+# acquisition-order edges against the static model (the CI `concurrency`
+# leg; deploy/RUNBOOK.md "Concurrency contracts"). Fails on a witnessed
+# inversion (conftest exit 3) or an edge the static graph cannot explain.
+witness-check:
+	TPE_LOCK_WITNESS=1 TPE_LOCK_WITNESS_OUT=lock-witness.json \
+		python -m pytest tests/ -q -m 'not slow'
+	python -m tpu_pod_exporter.analysis --check-witness lock-witness.json
 
 # Replay the round-5 real-hardware trace through the history flight
 # recorder and print what /api/v1/window_stats would answer — the offline

@@ -14,11 +14,21 @@ skips). ``pin_cpu_inprocess`` re-updates the already-imported jax config
 in-process — the numeric suites then run everywhere, hardware or not.
 """
 
+import os
 import subprocess
 import sys
 from pathlib import Path
 
 sys.path.insert(0, str(Path(__file__).resolve().parent.parent))
+
+# Lock witness (TPE_LOCK_WITNESS=1): must install BEFORE any package
+# module is imported, so module-level and constructor locks are created
+# through the patched factories. The CI concurrency leg runs tier-1 under
+# this and cross-checks the edge dump against the static lock-order graph
+# (`python -m tpu_pod_exporter.analysis --check-witness`).
+from tpu_pod_exporter.analysis import witness as _lock_witness  # noqa: E402
+
+_WITNESS = _lock_witness.install_from_env()
 
 import pytest  # noqa: E402
 
@@ -78,6 +88,40 @@ def require_jax():
 # but skip device verification (creating the XLA CPU client costs seconds)
 # so non-JAX test subsets don't pay for it; require_jax() verifies lazily.
 pin_cpu_inprocess(8, verify=False)
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    """Witness session report: edge/hold summary, inversions verbatim.
+    The edge dump is written either way so CI can cross-check it against
+    the static lock-order graph."""
+    if _WITNESS is None:
+        return
+    out = os.environ.get("TPE_LOCK_WITNESS_OUT", "lock-witness.json")
+    doc = _WITNESS.dump(out)
+    tr = terminalreporter
+    tr.write_sep("-", "lock witness")
+    meta = doc["meta"]
+    tr.write_line(
+        f"lock witness: {meta['locks']} lock site(s), "
+        f"{meta['acquisitions']} acquisition(s), {meta['edges']} order "
+        f"edge(s); dump -> {out}")
+    for inv in doc["inversions"]:
+        tr.write_line(f"INVERSION: {inv['detail']}", red=True)
+    if doc["long_holds"]:
+        worst = max(doc["long_holds"], key=lambda h: h["held_ms"])
+        tr.write_line(
+            f"{len(doc['long_holds'])} hold(s) over "
+            f"{meta['hold_warn_ms']} ms (worst: {worst['site']} "
+            f"{worst['held_ms']} ms on {worst['thread']}) — review, "
+            f"not a gate")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """A witnessed lock-order inversion fails the run even if every test
+    passed — the interleaving that deadlocks may just not have happened
+    this time."""
+    if _WITNESS is not None and _WITNESS.inversions:
+        session.exitstatus = 3
 
 
 @pytest.fixture

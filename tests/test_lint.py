@@ -579,6 +579,58 @@ class TestCli:
         doc = json.loads(proc.stdout)
         assert doc["findings"] == []
 
+    def test_sarif_format_clean_tree(self):
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pod_exporter.analysis",
+             "--format", "sarif"],
+            capture_output=True, text=True, cwd=str(REPO_ROOT),
+        )
+        assert proc.returncode == 0
+        doc = json.loads(proc.stdout)
+        assert doc["version"] == "2.1.0"
+        run = doc["runs"][0]
+        assert run["results"] == []
+        # Rule metadata rides the driver so annotations resolve ids.
+        ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+        for rule in ("lock-io", "metric-name", "lock-order",
+                     "lock-ownership", "lock-io-chain"):
+            assert rule in ids
+
+    def test_sarif_from_findings_list(self):
+        """to_sarif renders the SAME findings list the text/JSON paths
+        consume: severity maps to SARIF level, location carries the
+        repo-relative path + 1-based line."""
+        from tpu_pod_exporter.analysis.diagnostics import (
+            ERROR, WARNING, Diagnostic, to_sarif,
+        )
+        from tpu_pod_exporter.analysis.rules import ALL_RULES
+        findings = [
+            Diagnostic("lock-io", ERROR,
+                       "tpu_pod_exporter/collector.py", 42, "bad"),
+            Diagnostic("flag-doc", WARNING,
+                       "tpu_pod_exporter/config.py", 7, "undocumented"),
+        ]
+        doc = to_sarif(findings, ALL_RULES)
+        results = doc["runs"][0]["results"]
+        assert [r["level"] for r in results] == ["error", "warning"]
+        loc = results[0]["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == \
+            "tpu_pod_exporter/collector.py"
+        assert loc["region"]["startLine"] == 42
+        assert results[0]["ruleId"] == "lock-io"
+
+    def test_sarif_seeded_tree_carries_findings(self, seeded_tree):
+        root, _ = seeded_tree
+        proc = subprocess.run(
+            [sys.executable, "-m", "tpu_pod_exporter.analysis",
+             "--root", str(root), "--no-baseline", "--format", "sarif"],
+            capture_output=True, text=True, cwd=str(REPO_ROOT),
+        )
+        assert proc.returncode == 1
+        doc = json.loads(proc.stdout)
+        rules_hit = {r["ruleId"] for r in doc["runs"][0]["results"]}
+        assert {"lock-io", "metric-name"} <= rules_hit
+
     def test_list_rules(self):
         proc = subprocess.run(
             [sys.executable, "-m", "tpu_pod_exporter.analysis",

@@ -37,6 +37,7 @@ import threading
 import time
 import urllib.request
 from concurrent.futures import ThreadPoolExecutor
+from typing import Any, Callable, Iterable, Sequence
 
 from tpu_pod_exporter.collector import CollectorLoop
 from tpu_pod_exporter.metrics import (
@@ -230,8 +231,9 @@ class _WorkloadAgg:
         return len(self.hosts)
 
 
-def emit_rollups(b: SnapshotBuilder, slices, workloads, slice_groups,
-                 rlog=None) -> None:
+def emit_rollups(b: SnapshotBuilder, slices: dict, workloads: dict,
+                 slice_groups: dict,
+                 rlog: RateLimitedLogger | None = None) -> None:
     """Fold the round accumulators into rollup series on ``b`` — the ONE
     emit path for ``tpu_slice_*`` / ``tpu_multislice_*`` / ``tpu_workload_*``.
 
@@ -421,14 +423,14 @@ class TargetSet:
 
     def __init__(
         self,
-        targets=(),
+        targets: Sequence[str] = (),
         targets_file: str = "",
-        filter_fn=None,
+        filter_fn: Callable[[tuple[str, ...]], Iterable[str]] | None = None,
         breaker_failures: int = 0,
         breaker_backoff_s: float = 10.0,
         breaker_backoff_max_s: float = 120.0,
-        breaker_store=None,
-        wallclock=time.time,
+        breaker_store: Any = None,
+        wallclock: Callable[[], float] = time.time,
     ) -> None:
         self._file = targets_file
         self._file_mtime: float | None = None
@@ -474,7 +476,7 @@ class TargetSet:
         self.set_targets(base)
         self.moves = 0  # boot population is not churn
 
-    def set_targets(self, targets) -> tuple[int, int]:
+    def set_targets(self, targets: Sequence[str]) -> tuple[int, int]:
         """Replace membership; returns (added, removed) counts. Per-target
         state is created for newcomers (breakers restored from the saved
         store when present) and dropped for leavers."""
@@ -611,11 +613,14 @@ class RoundRecorder:
     reproduces outages too. Size note: a 256-chip body is ~950 KB, so an
     N-target capture grows ~N MB/round; record incidents, not weeks."""
 
-    def __init__(self, path: str, wallclock=time.time) -> None:
+    def __init__(self, path: str,
+                 wallclock: Callable[[], float] = time.time) -> None:
         self._f = open(path, "a", encoding="utf-8")
         self._wallclock = wallclock
 
-    def record(self, results) -> None:
+    def record(
+        self, results: Iterable[tuple[str, str | None, float]],
+    ) -> None:
         rec = {
             "t": self._wallclock(),
             "bodies": {t: text for t, text, _d in results},
@@ -698,21 +703,21 @@ class SliceAggregator:
         targets: tuple[str, ...],
         store: SnapshotStore,
         timeout_s: float = 2.0,
-        fetch=default_fetch,
-        wallclock=time.time,
+        fetch: Callable[..., Any] = default_fetch,
+        wallclock: Callable[[], float] = time.time,
         recorder: "RoundRecorder | None" = None,
-        loop_overruns_fn=None,  # () -> int, from the CollectorLoop
+        loop_overruns_fn: Callable[[], int] | None = None,  # CollectorLoop's
         history_fallback_window_s: float = 0.0,
-        history_fetch=default_history_fetch,
+        history_fetch: Callable[..., Any] = default_history_fetch,
         breaker_failures: int = 3,
         breaker_backoff_s: float = 10.0,
         breaker_backoff_max_s: float = 120.0,
-        tracer=None,
-        breaker_store=None,  # persist.BreakerStateFile; None = no persistence
-        fleet=None,  # fleet.FleetQueryPlane; publishes its self-metrics here
-        shipper=None,  # egress.RemoteWriteShipper; None = no push egress
+        tracer: Any = None,
+        breaker_store: Any = None,  # persist.BreakerStateFile; None = none
+        fleet: Any = None,  # fleet.FleetQueryPlane; self-metrics land here
+        shipper: Any = None,  # egress.RemoteWriteShipper; None = no egress
         targets_file: str = "",  # live membership: re-read on mtime change
-        target_filter=None,  # (tuple) -> iterable; the leaf tier's shard cut
+        target_filter: Callable[[tuple[str, ...]], Iterable[str]] | None = None,  # leaf tier's shard cut
         render_splice: bool = True,  # --render-splice; the RUNBOOK kill switch
     ) -> None:
         if not targets and not targets_file:
@@ -843,7 +848,7 @@ class SliceAggregator:
         of this reference always see current membership."""
         return self._tset.breakers
 
-    def set_fleet(self, fleet) -> None:
+    def set_fleet(self, fleet: Any) -> None:
         """Attach the federated query plane (constructed after the
         aggregator because it borrows the breaker map built here)."""
         self._fleet = fleet
@@ -946,7 +951,7 @@ class SliceAggregator:
             ]
             if failed:
 
-                def fallback(target: str):
+                def fallback(target: str) -> list | None:
                     span = (
                         tr.span("history_fallback") if tr is not None else None
                     )
@@ -1082,7 +1087,8 @@ class SliceAggregator:
 
     # ---------------------------------------------------------------- publish
 
-    def _publish(self, results, fallbacks=None,
+    def _publish(self, results: Sequence[tuple[str, str | None, float]],
+                 fallbacks: dict[str, list] | None = None,
                  round_started: float | None = None,
                  quarantined: set | None = None) -> None:
         b = SnapshotBuilder(prefix_cache=self._prefix_cache)
@@ -1224,7 +1230,9 @@ class SliceAggregator:
             self._round_hist.observe(round_dur)
 
     @staticmethod
-    def _consume(samples, slices, workloads, slice_groups) -> None:
+    def _consume(samples: Iterable[tuple[str, dict[str, str], float]],
+                 slices: dict, workloads: dict,
+                 slice_groups: dict) -> None:
         """Fold one host's parsed ``(name, labels, value)`` tuples into the
         round accumulators. The name dispatch is ordered by sample
         frequency — per-link ICI rows are ~60% of a 256-chip body's
@@ -1420,7 +1428,8 @@ class SliceAggregator:
             ),
         }
 
-    def _emit_extra(self, b, slices, workloads, slice_groups) -> None:
+    def _emit_extra(self, b: SnapshotBuilder, slices: dict,
+                    workloads: dict, slice_groups: dict) -> None:
         """Subclass hook, called once per round after the rollups landed on
         the builder and before the self-metrics: the sharded leaf tier
         (tpu_pod_exporter.shard.LeafAggregator) emits its accumulator
@@ -1658,7 +1667,7 @@ def main(argv: list[str] | None = None) -> int:
 
     stop = threading.Event()
 
-    def _on_signal(signum, frame) -> None:  # noqa: ARG001
+    def _on_signal(signum: int, frame: object) -> None:  # noqa: ARG001
         log.info("signal %d: draining", signum)
         stop.set()
 

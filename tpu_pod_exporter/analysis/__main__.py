@@ -4,10 +4,17 @@ Exit status: 0 when the tree is clean against the committed baseline,
 1 when any new finding exists (each printed as ``file:line: severity:
 rule: message``), 2 on operational errors (missing schema, bad root).
 
-``--demo`` seeds a deliberate lock-scoped ``json.dumps`` and an
-unregistered metric name into a temp copy of ``collector.py`` and shows
-the linter catching both — the lint analog of ``make chaos-demo``
-(exits 0 only if BOTH seeded violations are caught).
+``--demo`` seeds deliberate violations into a temp copy of the package —
+a lock-scoped ``json.dumps``, an unregistered metric name, a lock-order
+inversion pair, and a wrong-thread WAL cursor move — and exits 0 only if
+ALL four rule families catch their seed (the lint analog of ``make
+chaos-demo``).
+
+``--lock-graph``/``--lock-graph-dot`` render the concurrency model's
+acquisition-order graph (the committed ``deploy/lock-graph.json``
+artifact); ``--check-witness`` cross-checks a runtime witness edge dump
+(``tests/conftest.py`` under ``TPE_LOCK_WITNESS=1``) against the static
+model.
 """
 
 from __future__ import annotations
@@ -17,16 +24,28 @@ import json
 import os
 import sys
 
-from tpu_pod_exporter.analysis.diagnostics import ERROR
+from tpu_pod_exporter.analysis.diagnostics import ERROR, to_sarif
 from tpu_pod_exporter.analysis.engine import (
     apply_baseline,
     baseline_document,
+    build_context,
     lint_package,
     load_baseline,
 )
 from tpu_pod_exporter.analysis.rules import ALL_RULES
 
 BASELINE_NAME = ".exporter-lint-baseline.json"
+
+# (rule that must fire, what was seeded) — the --demo contract.
+_DEMO_EXPECTED = (
+    ("lock-io", "json.dumps(...) inside `with demo_lock:`"),
+    ("metric-name", "unregistered name 'tpu_exporter_demo_bogus_total'"),
+    ("lock-order", "two functions acquiring _demo_lock_a/_demo_lock_b "
+                   "in opposite orders"),
+    ("lock-ownership", "a 'tpu-demo-wrong-thread' thread calling "
+                       "WalBuffer.ack() (cursor move off the owner "
+                       "thread)"),
+)
 
 
 def _default_root() -> str:
@@ -36,7 +55,8 @@ def _default_root() -> str:
 
 
 def _run_demo(root: str) -> int:
-    """Copy collector.py aside, seed two violations, show the diagnostics."""
+    """Copy the package aside, seed one violation per rule family, and
+    require the linter to catch every one of them."""
     import shutil
     import tempfile
 
@@ -46,39 +66,72 @@ def _run_demo(root: str) -> int:
             os.path.join(root, "tpu_pod_exporter"), pkg,
             ignore=shutil.ignore_patterns("__pycache__"),
         )
-        target = os.path.join(pkg, "collector.py")
-        with open(target, "a") as f:
+        with open(os.path.join(pkg, "collector.py"), "a") as f:
             f.write(
                 "\n\n"
                 "def _lint_demo_seeded(snapshot, counters):\n"
                 "    # Seeded by `exporter-lint --demo`: BOTH lines below\n"
                 "    # violate an invariant rule on purpose.\n"
                 "    import json\n"
-                "    import threading\n"
                 "    demo_lock = threading.Lock()\n"
                 "    with demo_lock:\n"
                 "        body = json.dumps({'seeded': True})\n"
                 "    counters.inc('tpu_exporter_demo_bogus_total', ())\n"
                 "    return body\n"
+                "\n\n"
+                "# Seeded lock-order inversion: two paths, opposite order.\n"
+                "_demo_lock_a = threading.Lock()\n"
+                "_demo_lock_b = threading.Lock()\n"
+                "\n\n"
+                "def _lint_demo_order_one():\n"
+                "    with _demo_lock_a:\n"
+                "        with _demo_lock_b:\n"
+                "            pass\n"
+                "\n\n"
+                "def _lint_demo_order_two():\n"
+                "    with _demo_lock_b:\n"
+                "        with _demo_lock_a:\n"
+                "            pass\n"
             )
-        print("seeded into a temp copy of tpu_pod_exporter/collector.py:")
-        print("  - json.dumps(...) inside `with demo_lock:`   (rule lock-io)")
-        print("  - metric name 'tpu_exporter_demo_bogus_total' not in "
-              "schema.ALL_SPECS   (rule metric-name)")
+        with open(os.path.join(pkg, "persist.py"), "a") as f:
+            f.write(
+                "\n\n"
+                "class _LintDemoWrongThreadMover:\n"
+                "    # Seeded by `exporter-lint --demo`: a thread outside\n"
+                "    # the declared WalBuffer cursor-owner set moving the\n"
+                "    # cursor (the PR 11 governor-race bug class).\n"
+                "    def __init__(self) -> None:\n"
+                "        self._buf = WalBuffer('/tmp/lint-demo-wal')\n"
+                "        self._thread = threading.Thread(\n"
+                "            target=self._move,\n"
+                "            name='tpu-demo-wrong-thread', daemon=True,\n"
+                "        )\n"
+                "\n"
+                "    def _move(self) -> None:\n"
+                "        self._buf.ack()\n"
+            )
+        print("seeded into a temp copy of the package:")
+        for rule, what in _DEMO_EXPECTED:
+            print(f"  - {what}   (rule {rule})")
         print()
         findings = [
             d for d in lint_package(tmp)
-            if d.path == "tpu_pod_exporter/collector.py"
+            if d.path in ("tpu_pod_exporter/collector.py",
+                          "tpu_pod_exporter/persist.py")
         ]
         caught = set()
         for d in findings:
             print(d.format())
             caught.add(d.rule)
-        ok = {"lock-io", "metric-name"} <= caught
+        missing = [r for r, _ in _DEMO_EXPECTED if r not in caught]
         print()
-        print("demo:", "PASS — both seeded violations caught"
-              if ok else "FAIL — a seeded violation was NOT caught")
-        return 0 if ok else 1
+        if missing:
+            print(f"demo: FAIL — seeded violation(s) NOT caught: "
+                  f"{', '.join(missing)}")
+            return 1
+        print("demo: PASS — all seeded violations caught "
+              f"({', '.join(r for r, _ in _DEMO_EXPECTED)})")
+        return 0
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -95,12 +148,26 @@ def main(argv: list[str] | None = None) -> int:
                    help="report every finding, ignoring the baseline")
     p.add_argument("--update-baseline", action="store_true",
                    help="write all current findings to the baseline and exit 0")
-    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--format", choices=("text", "json", "sarif"),
+                   default="text",
+                   help="sarif emits SARIF 2.1.0 for inline PR "
+                        "annotations; json is the CI artifact shape")
     p.add_argument("--list-rules", action="store_true",
                    help="print the rule reference and exit")
     p.add_argument("--demo", action="store_true",
-                   help="seed a violation into a temp copy and show the "
-                        "diagnostic (make lint-demo)")
+                   help="seed one violation per rule family into a temp "
+                        "copy and require the linter to catch all of "
+                        "them (make lint-demo)")
+    p.add_argument("--lock-graph", metavar="PATH", default=None,
+                   help="write the lock-acquisition order graph (JSON, "
+                        "the reviewed deploy/lock-graph.json artifact) "
+                        "and exit")
+    p.add_argument("--lock-graph-dot", metavar="PATH", default=None,
+                   help="write the order graph as Graphviz DOT and exit")
+    p.add_argument("--check-witness", metavar="DUMP", default=None,
+                   help="cross-check a runtime lock-witness edge dump "
+                        "(tier-1 under TPE_LOCK_WITNESS=1) against the "
+                        "static model; non-zero on any unexplained edge")
     ns = p.parse_args(argv)
 
     if ns.list_rules:
@@ -116,6 +183,43 @@ def main(argv: list[str] | None = None) -> int:
 
     if ns.demo:
         return _run_demo(root)
+
+    if ns.lock_graph or ns.lock_graph_dot or ns.check_witness:
+        from tpu_pod_exporter.analysis import concurrency
+        model = concurrency.get_model(build_context(root))
+        if ns.lock_graph:
+            doc = model.graph_json()
+            with open(ns.lock_graph, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {len(doc['locks'])} lock(s), "
+                  f"{len(doc['edges'])} edge(s) to {ns.lock_graph}")
+        if ns.lock_graph_dot:
+            with open(ns.lock_graph_dot, "w", encoding="utf-8") as f:
+                f.write(model.graph_dot())
+            print(f"wrote DOT graph to {ns.lock_graph_dot}")
+        if ns.check_witness:
+            from tpu_pod_exporter.analysis import witness as witness_mod
+            try:
+                dump = witness_mod.load_dump(ns.check_witness)
+            except (OSError, ValueError) as e:
+                print(f"exporter-lint: cannot read witness dump: {e}",
+                      file=sys.stderr)
+                return 2
+            problems = concurrency.cross_check(model, dump)
+            meta = dump.get("meta", {})
+            print(f"witness dump: {meta.get('locks', '?')} lock(s), "
+                  f"{meta.get('acquisitions', '?')} acquisition(s), "
+                  f"{meta.get('edges', '?')} order edge(s)")
+            for prob in problems:
+                print(f"CROSS-CHECK: {prob}")
+            if problems:
+                print(f"exporter-lint: witness cross-check FAILED "
+                      f"({len(problems)} problem(s))")
+                return 1
+            print("exporter-lint: witness cross-check OK — every "
+                  "witnessed edge is explained by the static model")
+        return 0
 
     findings = lint_package(root)
     baseline_path = ns.baseline or os.path.join(root, BASELINE_NAME)
@@ -134,7 +238,9 @@ def main(argv: list[str] | None = None) -> int:
             findings, load_baseline(baseline_path), root
         )
 
-    if ns.format == "json":
+    if ns.format == "sarif":
+        print(json.dumps(to_sarif(findings, ALL_RULES), indent=1))
+    elif ns.format == "json":
         print(json.dumps({
             "findings": [
                 {

@@ -53,6 +53,56 @@ class Diagnostic:
         return h.hexdigest()[:16]
 
 
+def to_sarif(findings: list[Diagnostic], rules: tuple = ()) -> dict:
+    """SARIF 2.1.0 document from one findings list — the same list the
+    text/JSON renderers consume, so CI can annotate PRs inline without a
+    second lint pass. ``rules`` is the ALL_RULES tuple (passed in to keep
+    this module import-light)."""
+    level = {ERROR: "error", WARNING: "warning"}
+    return {
+        "$schema": "https://json.schemastore.org/sarif-2.1.0.json",
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {
+                "driver": {
+                    "name": "exporter-lint",
+                    "informationUri": (
+                        "https://example.invalid/tpu-pod-exporter"
+                        "#static-analysis"
+                    ),
+                    "rules": [
+                        {
+                            "id": r.name,
+                            "shortDescription": {"text": r.summary},
+                            "defaultConfiguration": {
+                                "level": level.get(r.severity, "warning"),
+                            },
+                        }
+                        for r in rules
+                    ],
+                },
+            },
+            "results": [
+                {
+                    "ruleId": d.rule,
+                    "level": level.get(d.severity, "warning"),
+                    "message": {"text": d.message},
+                    "locations": [{
+                        "physicalLocation": {
+                            "artifactLocation": {
+                                "uri": d.path,
+                                "uriBaseId": "SRCROOT",
+                            },
+                            "region": {"startLine": max(d.line, 1)},
+                        },
+                    }],
+                }
+                for d in findings
+            ],
+        }],
+    }
+
+
 def parse_disables(line: str) -> dict[str, str]:
     """Extract ``{rule: reason}`` from one source line's disable comment.
 

@@ -521,6 +521,29 @@ def _check_flag_doc(ctx: "LintContext") -> list[Diagnostic]:
     return out
 
 
+# ------------------------------------------------ concurrency contracts
+
+# The three whole-tree concurrency rules live in analysis/concurrency.py
+# (lock discovery, call graph, held-set propagation — too much machinery
+# for this file). Imported lazily so `concurrency` can borrow
+# _lock_io_offence from here without a cycle.
+
+
+def _check_lock_order(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import concurrency
+    return concurrency.check_lock_order(ctx)
+
+
+def _check_lock_ownership(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import concurrency
+    return concurrency.check_lock_ownership(ctx)
+
+
+def _check_lock_io_chain(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import concurrency
+    return concurrency.check_lock_io_chain(ctx)
+
+
 # ------------------------------------------------------------------- registry
 
 ALL_RULES: tuple[Rule, ...] = (
@@ -583,6 +606,27 @@ ALL_RULES: tuple[Rule, ...] = (
         "Every flag defined in config.py must be documented in README.md "
         "or deploy/RUNBOOK.md.",
         check_tree=_check_flag_doc,
+    ),
+    Rule(
+        "lock-order", ERROR,
+        "The whole-tree lock-acquisition order graph must be acyclic, "
+        "and no non-reentrant lock may be re-acquired while held "
+        "(deadlock candidates; analysis/concurrency.py).",
+        check_tree=_check_lock_order,
+    ),
+    Rule(
+        "lock-ownership", ERROR,
+        "Declared thread-ownership contracts (one cursor-mover per "
+        "buffer, one history appender, flag-checked-under-lock) hold "
+        "over the thread-rooted call graph.",
+        check_tree=_check_lock_ownership,
+    ),
+    Rule(
+        "lock-io-chain", ERROR,
+        "No call chain reachable under a held lock may perform I/O, "
+        "serialization, compression, or logging (lock-io, "
+        "interprocedural).",
+        check_tree=_check_lock_io_chain,
     ),
 )
 

@@ -12,6 +12,7 @@ import os
 import signal
 import threading
 import time
+from typing import Any
 
 from tpu_pod_exporter import utils
 from tpu_pod_exporter.attribution import AttributionProvider
@@ -138,7 +139,7 @@ def _build_named_attribution(choice: str, cfg: ExporterConfig,
     raise ValueError(f"unknown attribution: {choice}")
 
 
-def _build_uid_source(cfg: ExporterConfig):
+def _build_uid_source(cfg: ExporterConfig) -> Any:
     """UID→name resolver for the checkpoint path (None = uid-keyed series).
     A static file wins over the kubelet /pods endpoint when both are set."""
     if cfg.uid_map_file:
@@ -704,7 +705,7 @@ def main(argv: list[str] | None = None) -> int:
     app = ExporterApp(cfg)
     stop = threading.Event()
 
-    def _on_signal(signum, frame) -> None:  # noqa: ARG001
+    def _on_signal(signum: int, frame: object) -> None:  # noqa: ARG001
         log.info("signal %d: draining", signum)
         stop.set()
 

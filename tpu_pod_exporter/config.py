@@ -13,6 +13,7 @@ from __future__ import annotations
 import argparse
 import os
 from dataclasses import dataclass, fields
+from typing import Any
 
 
 @dataclass
@@ -239,7 +240,7 @@ class ExporterConfig:
     log_format: str = "text"
 
     @staticmethod
-    def _env_default(name: str, fallback):
+    def _env_default(name: str, fallback: Any) -> Any:
         raw = os.environ.get(f"TPE_{name.upper()}")
         if raw is None:
             return fallback

@@ -404,7 +404,7 @@ class FleetStore:
         with self._lock:
             for ti, buf in enumerate(self._buffers):
                 step = self.tier_spec[ti][0]
-                for payload in buf.iter_payloads():
+                for payload in buf.iter_payloads():  # lint: disable=lock-io-chain(boot replay: open() runs before the round thread or HTTP queries exist, and holding the store lock keeps the restore atomic against an early query; no contention is possible here)
                     restored += self._replay_record_locked(ti, step, payload)
             # Re-open every series' newest restored bucket as the live
             # accumulator and resume counter-delta tracking from its last
