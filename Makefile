@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo clean
+.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo dashboard-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -176,6 +176,25 @@ egress-drain-check:
 scenario-demo:
 	python -m tpu_pod_exporter.loadgen.scenario --targets 120 --shards 4 \
 		--state-root scenario-demo-state
+
+# Streaming dashboard plane acceptance (deploy/RUNBOOK.md "Dashboard storm
+# playbook"): 5000 concurrent /api/v1/stream subscriptions held against
+# one root + 2 stateless read replicas over a real leaf tier. Asserts
+# bounded per-round push p99, flat RSS through the storm, zero duplicate/
+# missed rounds per subscriber, delta replay == the polled answer for
+# every sampled subscriber every round, a replica kill mid-stream
+# degrading ONLY its own viewers (they reconnect to a peer and resync),
+# and counted subscriber-shed semantics. The second run is the NEGATIVE
+# CONTROL: one delta frame per subscriber is dropped client-side and the
+# replay-equality invariant must catch it (the drill proves it can fail).
+dashboard-demo:
+	python -m tpu_pod_exporter.loadgen.fleet --mode dashboard \
+		--targets 100 --shards 4 --chips 2 --subs 5000 --rounds 10 \
+		--replicas 2 --state-root dashboard-demo-state
+	python -m tpu_pod_exporter.loadgen.fleet --mode dashboard \
+		--targets 24 --shards 2 --chips 2 --subs 48 --rounds 4 \
+		--replicas 1 --state-root dashboard-demo-state/negative \
+		--negative
 
 # Resource-pressure governor acceptance (deploy/RUNBOOK.md "Resource
 # pressure playbook"): three drills against real components —

@@ -315,11 +315,29 @@ def slow_client_drill(port: int, child_pid: int, conns: int = 48) -> dict:
             except (OSError, ValueError):
                 time.sleep(1.0)
     threads_after = proc_threads(child_pid)
+    # ISSUE 15 satellite: the worker pool a storm grew must REAP back to
+    # baseline once idle (r06 regression: threads_after 17 vs 10 — the
+    # notify-rotation reap bug). The pool's idle grace is 10 s; keep a
+    # trickle of fast scrapes flowing meanwhile, because that trickle is
+    # exactly the traffic pattern that defeated the old reap.
+    threads_after_reap = threads_after
+    reap_deadline = time.monotonic() + 25.0
+    while time.monotonic() < reap_deadline:
+        try:
+            http_get("127.0.0.1", port, "/metrics")
+        except OSError:
+            pass
+        threads_after_reap = proc_threads(child_pid)
+        if threads_after_reap <= threads_before + 1:
+            break
+        time.sleep(1.0)
     return {
         "conns": conns,
         "threads_before": threads_before,
         "threads_during": threads_during,
         "threads_after": threads_after,
+        "threads_after_reap": threads_after_reap,
+        "reaped_to_baseline": threads_after_reap <= threads_before + 1,
         "write_timeout_drops": dropped,
         "responsive_during_stall": responsive,
         "fast_client_latency_ms_during_stall": round(fast_lat_ms, 3),
@@ -370,12 +388,91 @@ def burst_smoke(min_per_s: float) -> int:
         receiver.stop()
 
 
+def dashboard_bench(subs: int, targets: int, out_path: str) -> int:
+    """BENCH_r07: the streaming dashboard plane vs the pull baseline,
+    plus the r06 follow-ups (identity keep-alive fast path via sendmsg
+    scatter-gather; worker-pool idle reap). Writes ``out_path``."""
+    from tpu_pod_exporter.chaos import ChaosReceiver
+    from tpu_pod_exporter.loadgen.fleet import run_dashboard_demo
+
+    results: dict = {"bench": "r07", "chips": 256}
+    receiver = ChaosReceiver([], host="127.0.0.1", port=0)
+    receiver.start()
+    child, port, child_pid = spawn_child(256, receiver.url)
+    try:
+        for _ in range(5):
+            http_get("127.0.0.1", port, "/metrics")
+        # Identity fast path: r06 measured 322/s plain vs 12051/s gzip —
+        # the ~975 KB identity body was copy/syscall-bound. The sendmsg
+        # scatter-gather path coalesces head+body into one syscall per
+        # send window. Median of 3 bursts: the plain number swings ±30%
+        # on a shared box (kernel copy + scheduler noise), and a single
+        # lucky/unlucky burst would record a lie in either direction.
+        def median3(gz: bool) -> float:
+            rates = sorted(keepalive_burst(port, seconds=2.0, gzip=gz)
+                           for _ in range(3))
+            return round(rates[1], 1)
+
+        results["keepalive_plain_per_s"] = median3(False)
+        results["keepalive_gzip_per_s"] = median3(True)
+        results["keepalive_note"] = (
+            "median of 3 bursts; plain (identity ~975 KB body) remains "
+            "kernel-copy-bound — sendmsg coalescing buys the head+body "
+            "syscall, not the copy"
+        )
+    finally:
+        reap_child(child)
+        receiver.stop()
+    # Slow-client drill (2048-chip body) with the reap-to-baseline check.
+    receiver = ChaosReceiver([], host="127.0.0.1", port=0)
+    receiver.start()
+    child, port, child_pid = spawn_child(2048, receiver.url)
+    try:
+        for _ in range(3):
+            http_get("127.0.0.1", port, "/metrics")
+        results["slow_clients"] = slow_client_drill(port, child_pid)
+    finally:
+        reap_child(child)
+        receiver.stop()
+    # Dashboard storm vs pull baseline (in-process harness; scale is the
+    # local acceptance run — make dashboard-demo runs the full 5k).
+    import tempfile
+
+    with tempfile.TemporaryDirectory(prefix="bench-dash-") as tmp:
+        dash = run_dashboard_demo(
+            targets, 4, 2, subs, rounds=8, replicas=2, state_root=tmp,
+            push_p99_budget_s=1.5,
+        )
+    results["dashboard"] = {
+        k: dash.get(k) for k in (
+            "ok", "targets", "subs", "replicas", "connected", "rounds",
+            "frames_delivered", "push_p99_s", "gaps", "dups",
+            "equality_checked", "equality_failures", "rss_delta_mb",
+            "pull_baseline", "replica_kill", "shed", "took_s",
+        )
+    }
+    ok = (bool(dash.get("ok"))
+          and results["slow_clients"]["reaped_to_baseline"])
+    results["ok"] = ok
+    with open(out_path, "w", encoding="utf-8") as f:
+        json.dump(results, f, indent=1)
+        f.write("\n")
+    print(json.dumps(results, indent=1))
+    print(f"wrote {out_path}: {'OK' if ok else 'FAILED'}")
+    return 0 if ok else 1
+
+
 def main() -> int:
     args = [a for a in sys.argv[1:]]
     if args and args[0] == "--serve":
         return serve(int(args[1]), args[2] if len(args) > 2 else "")
     if args and args[0] == "--burst-smoke":
         return burst_smoke(float(args[1]) if len(args) > 1 else 200.0)
+    if args and args[0] == "--dashboard":
+        subs = int(args[1]) if len(args) > 1 else 2000
+        targets = int(args[2]) if len(args) > 2 else 100
+        out = args[3] if len(args) > 3 else "BENCH_r07.json"
+        return dashboard_bench(subs, targets, out)
     chips = int(args[0]) if args else 256
     scrapes = int(args[1]) if len(args) > 1 else 400
     from tpu_pod_exporter.chaos import ChaosReceiver

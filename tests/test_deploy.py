@@ -49,8 +49,8 @@ def recorded_rule_names(doc) -> set:
 
 @pytest.mark.parametrize(
     "manifest",
-    ["daemonset.yaml", "aggregator.yaml", "prometheus-example.yaml",
-     "prometheus-rules.yaml"],
+    ["daemonset.yaml", "aggregator.yaml", "replica.yaml",
+     "prometheus-example.yaml", "prometheus-rules.yaml"],
 )
 def test_manifest_parses(manifest):
     list(yaml.safe_load_all((DEPLOY / manifest).read_text()))

@@ -839,3 +839,62 @@ class TestClientWriteTimeout:
             assert server.write_timeouts["total"] == 0
         finally:
             server.stop()
+
+
+class TestWorkerPoolIdleReap:
+    """ISSUE 15 satellite: a pool grown under a stall must shrink back.
+
+    The old reap only fired when cv.wait() timed out; submit()'s notify()
+    rotates through waiters, so ANY steady trickle of requests kept every
+    storm-grown worker alive forever (BENCH_r06 slow_clients
+    threads_after 17 vs 10). The reap now keys on each worker's idle age
+    since ITS last completed task."""
+
+    def test_pool_grows_then_reaps_to_baseline_while_trickling(self):
+        import threading
+        import time
+
+        from tpu_pod_exporter.server import _WorkerPool
+
+        pool = _WorkerPool(8, idle_expire_s=0.25)
+        gate = threading.Event()
+        started = threading.Semaphore(0)
+
+        def stall():
+            started.release()
+            gate.wait(10.0)
+
+        for _ in range(8):
+            pool.submit(stall)
+        for _ in range(8):
+            assert started.acquire(timeout=5.0)
+        assert pool.threads == 8
+        gate.set()
+        # A trickle of instant tasks — the exact traffic pattern that
+        # defeated the timeout-only reap (each notify() refreshed a
+        # DIFFERENT waiter's timeout). The idle-age reap shrinks the pool
+        # to what the trickle actually needs.
+        deadline = time.monotonic() + 8.0
+        while time.monotonic() < deadline and pool.threads > 2:
+            pool.submit(lambda: None)
+            time.sleep(0.05)
+        assert pool.threads <= 2, (
+            f"pool never reaped: {pool.threads} threads after trickle"
+        )
+        pool.shutdown()
+
+    def test_quiet_pool_reaps_fully(self):
+        import time
+
+        from tpu_pod_exporter.server import _WorkerPool
+
+        pool = _WorkerPool(4, idle_expire_s=0.2)
+        done = []
+        for _ in range(4):
+            pool.submit(lambda: done.append(1))
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and pool.threads:
+            time.sleep(0.05)
+        assert pool.threads == 0
+        assert len(done) == 4
+        pool.shutdown()

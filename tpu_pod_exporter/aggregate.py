@@ -729,6 +729,15 @@ class SliceAggregator:
         # `rounds` — the result cache's generation, so cached envelopes
         # live exactly one round.
         self._fleet = fleet
+        # Attachment seams for conditional planes that ride this tier's
+        # rounds and exposition without the aggregator knowing them by
+        # name (the streaming dashboard hub is the first user):
+        # emit_hooks run inside _publish on the round's SnapshotBuilder;
+        # round_hooks run at the very end of poll_once with the new round
+        # number (poll-side cost must stay trivial — the stream hub's is
+        # one Event.set on its pump).
+        self.emit_hooks: list[Callable[[SnapshotBuilder], None]] = []
+        self.round_hooks: list[Callable[[int], None]] = []
         # Remote-write egress (tpu_pod_exporter.egress): the aggregator
         # ships its slice/workload rollups the same WAL-buffered way the
         # exporter ships chip series — the round loop's only involvement
@@ -990,6 +999,12 @@ class SliceAggregator:
         # the same persist-outside-the-timings discipline the exporter's
         # poll applies.
         self._maybe_save_breakers()
+        for hook in self.round_hooks:
+            try:
+                hook(self.rounds)
+            except Exception as e:  # noqa: BLE001 — a hook must never fail a round
+                self._rlog.warning("round_hook",
+                                   "round hook failed: %s", e)
 
     def _history_fallback(self, target: str) -> list | None:
         """Last-known chip data from a down target's flight recorder, as
@@ -1178,6 +1193,11 @@ class SliceAggregator:
         if self._fleet is not None:
             try:
                 self._fleet.emit(b)
+            except Exception:  # noqa: BLE001 — accounting must never fail a round
+                pass
+        for emit_hook in self.emit_hooks:
+            try:
+                emit_hook(b)
             except Exception:  # noqa: BLE001 — accounting must never fail a round
                 pass
         if self._shipper is not None:
@@ -1512,6 +1532,27 @@ def main(argv: list[str] | None = None) -> int:
                    help="fleet query result cache entries, keyed by "
                         "(query, grid, round generation) — absorbs "
                         "dashboard-refresh traffic (0 disables)")
+    p.add_argument("--stream", default="on", choices=("on", "off"),
+                   help="/api/v1/stream subscriptions over the fleet "
+                        "query plane: viewers register a query once and "
+                        "receive per-round deltas (SSE + long-poll "
+                        "fallback) instead of re-polling; requires "
+                        "--fleet-query on")
+    p.add_argument("--stream-max-subscribers", type=int, default=10000,
+                   help="admission cap on live stream subscriptions "
+                        "(past it: 429, retry against a read replica)")
+    p.add_argument("--stream-heartbeat-s", type=float, default=10.0,
+                   help="stream heartbeat cadence while rounds are "
+                        "quiet; 0 disables")
+    p.add_argument("--stream-full-sync-s", type=float, default=60.0,
+                   help="periodic full-answer frames on every stream "
+                        "(delta-only streams rot); 0 disables")
+    p.add_argument("--memory-budget-mb", type=float, default=0.0,
+                   help="memory budget over the serving components "
+                        "(fleet query result cache, stream hub retained "
+                        "answers): past it the pressure ladder sheds "
+                        "the cache first, then the oldest stream "
+                        "subscriptions (counted). 0 = no budget")
     p.add_argument("--egress-url", default="",
                    help="Prometheus remote-write receiver: push the slice/"
                         "workload rollups there, WAL-buffered (empty "
@@ -1651,6 +1692,27 @@ def main(argv: list[str] | None = None) -> int:
             targets_fn=lambda: agg.targets,
         )
         agg.set_fleet(fleet)
+    hub = pump = None
+    if ns.stream == "on" and fleet is not None:
+        from tpu_pod_exporter.stream import attach_stream
+
+        hub, pump = attach_stream(
+            agg, fleet,
+            heartbeat_s=ns.stream_heartbeat_s,
+            full_sync_s=ns.stream_full_sync_s,
+            max_subscribers=ns.stream_max_subscribers,
+        )
+    governor = None
+    if ns.memory_budget_mb > 0:
+        from tpu_pod_exporter.pressure import build_serving_governor
+
+        # Serving-tier memory ladder: result cache sheds first, oldest
+        # stream subscriptions last (stream_shed rung, counted).
+        governor = build_serving_governor(
+            int(ns.memory_budget_mb * (1 << 20)),
+            sidecar_dir=ns.state_dir,
+            cache_plane=fleet, hub=hub,
+        )
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
@@ -1663,6 +1725,7 @@ def main(argv: list[str] | None = None) -> int:
         # Partition-aware readiness: all-targets-dark flips /readyz to
         # state=degraded (still HTTP 200 — the stale view keeps serving).
         ready_detail_fn=agg.ready_detail,
+        stream_hub=hub,
     )
 
     stop = threading.Event()
@@ -1682,6 +1745,12 @@ def main(argv: list[str] | None = None) -> int:
     stop.wait()
     loop.stop()
     server.stop()
+    if pump is not None:
+        pump.close()
+    if hub is not None:
+        hub.close()
+    if governor is not None:
+        governor.close()
     if fleet is not None:
         fleet.close()
     if shipper is not None:

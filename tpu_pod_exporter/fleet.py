@@ -197,6 +197,12 @@ class _QueryCache:
             return len(self._data)
 
 
+# Public alias: the root query plane (shard.RootQueryPlane) reuses the
+# same bounded LRU + byte-accounting for ITS generation-keyed result
+# cache — one cache implementation, one memory-accounting story.
+QueryCache = _QueryCache
+
+
 class FleetQueryPlane:
     """Fan ``/api/v1`` queries out to every target; merge with partial-result
     semantics. Runs entirely on HTTP handler threads + its own pool — the

@@ -380,6 +380,14 @@ class SynthTargetFarm:
         # churn storm — workload label sets turn over wholesale).
         self.hot: set[int] = set()
         self.pod_gen = 0
+        # Dashboard-storm realism knob: a target's /api/v1 value advances
+        # only every api_churn-th round for it (staggered by idx), so per
+        # round only ~1/api_churn of the fleet's series change — the
+        # changed-series-only delta stream has something to be sparse
+        # about. 1 (default) = every value changes every round, the
+        # pre-existing behavior every other harness assumes.
+        self.api_churn = 1
+        self._api_epoch = time.time()
         farm = self
 
         class _FarmHandler(http.server.BaseHTTPRequestHandler):
@@ -550,14 +558,34 @@ class SynthTargetFarm:
 
         params = dict(urllib.parse.parse_qsl(query))
         metric = params.get("metric", "tpu_hbm_used_bytes")
-        value = float((idx + 1) * 2**20 + self.round * 65536)
+        sl = f"slice-{idx % self.n_slices}"
+        want_slice = params.get("match[slice_name]")
+        if want_slice and want_slice != sl:
+            # Label-matched queries cut the row set the way real node
+            # history does — a dashboard panel watching one slice must
+            # not stream every host in the fleet.
+            if route == "series":
+                return json.dumps([])
+            return json.dumps({"status": "ok", "data": {"result": []}})
+        churn = max(self.api_churn, 1)
+        # The value's round component advances when (round + idx) % churn
+        # wraps — staggered per target, pure function of (idx, round).
+        vround = self.round - (self.round + idx) % churn
+        value = float((idx + 1) * 2**20 + vround * 65536)
         row = {
             "metric": metric,
             "labels": {"host": f"host-{idx:04d}",
-                       "slice_name": f"slice-{idx % self.n_slices}"},
+                       "slice_name": sl},
+            # samples rides the VALUE round too: a live `self.round` here
+            # would mark every row changed every round and defeat the
+            # api_churn sparsity the delta drills measure.
             "stats": {"last": value, "min": value, "max": value,
-                      "mean": value, "samples": max(self.round, 1)},
-            "last_sample_wall_ts": time.time(),
+                      "mean": value, "samples": max(vround, 1)},
+            # Deterministic per (idx, value round): a row whose value did
+            # not advance is byte-identical across polls, so the delta
+            # stream ships ONLY genuinely-changed series (a wall-clock
+            # stamp here would mark every row changed every round).
+            "last_sample_wall_ts": round(self._api_epoch + vround, 3),
         }
         if route == "series":
             return json.dumps([row])
@@ -1274,6 +1302,736 @@ def run_shard_demo(n_targets: int, shards: int, ha: bool, chips: int,
         sim.close()
 
 
+# ------------------------------------------------- dashboard storm (mode 3)
+
+
+class _ReplicaSim:
+    """One in-process stateless read replica: a read-only RootAggregator
+    over the same leaf topology, its own two-level query plane with the
+    generation-keyed cache, a stream hub, and a real HTTP server —
+    exactly what ``tpu-pod-exporter-shard --role replica`` builds.
+    Rounds are caller-ticked like everything else in the sim."""
+
+    def __init__(self, name: str, topology, root_url: str,
+                 timeout_s: float = 5.0, max_subscribers: int = 20000,
+                 heartbeat_s: float = 5.0, full_sync_s: float = 20.0) -> None:
+        from tpu_pod_exporter.metrics import SnapshotStore
+        from tpu_pod_exporter.server import MetricsServer
+        from tpu_pod_exporter.shard import (
+            ReplicaSourceProxy,
+            RootAggregator,
+            RootQueryPlane,
+        )
+        from tpu_pod_exporter.stream import StreamHub, plane_poll_fn
+
+        self.name = name
+        self.alive = True
+        self.store = SnapshotStore()
+        self.root = RootAggregator(topology, self.store,
+                                   timeout_s=timeout_s)
+        self.plane = ReplicaSourceProxy(
+            RootQueryPlane(topology, timeout_s=timeout_s + 0.5,
+                           leaf_breakers=self.root._breakers,
+                           generation_fn=lambda: self.root.rounds),
+            replica_id=name, root_url=root_url,
+        )
+        self.root.emit_hooks.append(self.plane.emit)
+        self.poll_fn = plane_poll_fn(self.plane)
+        self.hub = StreamHub(self.poll_fn, lambda: self.root.rounds,
+                             heartbeat_s=heartbeat_s,
+                             full_sync_s=full_sync_s,
+                             max_subscribers=max_subscribers)
+        self.root.emit_hooks.append(self.hub.emit)
+        self.server = MetricsServer(self.store, host="127.0.0.1", port=0,
+                                    fleet=self.plane, stream_hub=self.hub)
+        self.server.start()
+        self.addr = ("127.0.0.1", self.server.port)
+
+    def tick_round(self) -> None:
+        if not self.alive:
+            return
+        self.root.poll_once()
+        self.hub.on_round(self.root.rounds)
+
+    def kill(self) -> None:
+        """Replica death mid-stream: the server drops every subscriber
+        connection (they must reconnect to a peer); nothing durable is
+        lost because a replica owns nothing durable."""
+        if not self.alive:
+            return
+        self.alive = False
+        self.server.stop()
+
+    def close(self) -> None:
+        self.kill()
+        self.hub.close()
+        self.plane.close()
+        self.root.close()
+
+
+class _StormSubscribers:
+    """5-10k concurrent SSE subscriptions across a few selector loops.
+
+    Each connection applies its frames through a
+    :class:`~tpu_pod_exporter.stream.StreamReplay` (so gaps/dups/replay
+    state are tracked per subscriber), records per-frame push latency
+    (receiver wall clock minus the frame's emission ts — one process, one
+    clock), and on EOF reconnects to a live peer endpoint — the
+    replica-kill degradation story. Connections are sharded over
+    ``workers`` independent selector threads so the measurement harness
+    itself does not become the latency bottleneck at 5k+ subscribers.
+    ``drop_one_delta`` is the NEGATIVE control: one delta frame per
+    connection is discarded before replay, which the equality invariant
+    must catch."""
+
+    def __init__(self, drop_one_delta: bool = False,
+                 workers: int = 4) -> None:
+        import selectors
+        import socket as socket_mod
+
+        self._selectors = selectors
+        self._socket = socket_mod
+        self._lock = threading.Lock()
+        self._stopping = False
+        self.drop_one_delta = drop_one_delta
+        self.conns: dict[int, dict] = {}
+        self._next_id = 0
+        self._endpoints: list[tuple[str, tuple[str, int]]] = []
+        self._dead_endpoints: set[str] = set()
+        self.connect_failures = 0
+        self._workers: list[dict] = []
+        for i in range(max(1, workers)):
+            sel = selectors.DefaultSelector()
+            wr, ww = socket_mod.socketpair()
+            wr.setblocking(False)
+            ww.setblocking(False)
+            sel.register(wr, selectors.EVENT_READ, None)
+            w = {"sel": sel, "wake_r": wr, "wake_w": ww, "pending": [],
+                 "idx": i}
+            w["thread"] = threading.Thread(
+                target=self._run, args=(w,),
+                name=f"tpu-dash-storm-{i}", daemon=True)
+            self._workers.append(w)
+            w["thread"].start()
+
+    # ------------------------------------------------------------- control
+
+    def _post(self, w, fn) -> None:
+        with self._lock:
+            w["pending"].append(fn)
+        try:
+            w["wake_w"].send(b"\x00")
+        except OSError:
+            pass
+
+    def set_endpoints(self, endpoints) -> None:
+        """[(label, (host, port)), ...] — reconnect targets."""
+        with self._lock:
+            self._endpoints = list(endpoints)
+
+    def mark_dead(self, label: str) -> None:
+        with self._lock:
+            self._dead_endpoints.add(label)
+
+    def open(self, n: int, shapes, spread=None) -> None:
+        """Open n subscriptions round-robin across live endpoints (or
+        ``spread``, a list of labels) and shapes, sharded over the
+        worker loops."""
+        from tpu_pod_exporter.stream import stream_path
+
+        with self._lock:
+            eps = {label: addr for label, addr in self._endpoints}
+            labels = spread or [label for label, _ in self._endpoints]
+        per = [[] for _ in self._workers]
+        for i in range(n):
+            per[i % len(per)].append(
+                (labels[i % len(labels)], shapes[i % len(shapes)]))
+        for w, batch in zip(self._workers, per):
+            def start(w=w, batch=batch) -> None:
+                for label, shape in batch:
+                    self._connect(w, label, eps[label], shape,
+                                  stream_path(shape))
+            self._post(w, start)
+
+    def _connect(self, w, label, addr, shape, path) -> int | None:
+        from tpu_pod_exporter.stream import SseParser, StreamReplay
+
+        sock = self._socket.socket()
+        sock.setblocking(False)
+        try:
+            sock.connect_ex(addr)
+        except OSError:
+            self.connect_failures += 1
+            sock.close()
+            return None
+        with self._lock:
+            cid = self._next_id
+            self._next_id += 1
+        conn = {
+            "id": cid, "sock": sock, "label": label, "shape": shape,
+            "worker": w,
+            "out": bytearray(
+                f"GET {path} HTTP/1.1\r\nHost: storm\r\n"
+                f"Accept: text/event-stream\r\n\r\n".encode()),
+            "head": bytearray(), "in_body": False,
+            "parser": SseParser(), "replay": StreamReplay(),
+            "latencies": [], "reconnects": 0, "dropped": False,
+            "status": 0, "closed": False,
+        }
+        with self._lock:
+            self.conns[cid] = conn
+        w["sel"].register(
+            sock,
+            self._selectors.EVENT_READ | self._selectors.EVENT_WRITE,
+            conn,
+        )
+        return cid
+
+    # ---------------------------------------------------------------- loop
+
+    def _run(self, w) -> None:
+        sel = w["sel"]
+        EVENT_READ = self._selectors.EVENT_READ
+        EVENT_WRITE = self._selectors.EVENT_WRITE
+        while not self._stopping:
+            for key, mask in sel.select(0.2):
+                if key.fileobj is w["wake_r"]:
+                    try:
+                        while w["wake_r"].recv(4096):
+                            pass
+                    except OSError:
+                        pass
+                    continue
+                conn = key.data
+                if conn["closed"]:
+                    continue
+                if mask & EVENT_WRITE and conn["out"]:
+                    try:
+                        n = conn["sock"].send(conn["out"])
+                        del conn["out"][:n]
+                    except (BlockingIOError, InterruptedError):
+                        pass
+                    except OSError:
+                        self._drop(conn)
+                        continue
+                    if not conn["out"]:
+                        sel.modify(conn["sock"], EVENT_READ, conn)
+                if mask & EVENT_READ:
+                    self._readable(conn)
+            with self._lock:
+                pending, w["pending"] = w["pending"], []
+            for fn in pending:
+                try:
+                    fn()
+                except Exception:  # noqa: BLE001 — storm must keep running
+                    pass
+        for conn in list(self.conns.values()):
+            if conn["worker"] is w:
+                self._drop(conn, reconnect=False)
+        sel.close()
+        w["wake_r"].close()
+        w["wake_w"].close()
+
+    def _readable(self, conn) -> None:
+        try:
+            data = conn["sock"].recv(65536)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not data:
+            self._drop(conn)
+            return
+        if not conn["in_body"]:
+            conn["head"] += data
+            idx = conn["head"].find(b"\r\n\r\n")
+            if idx < 0:
+                return
+            head = bytes(conn["head"][:idx])
+            rest = bytes(conn["head"][idx + 4:])
+            parts = head.split(b"\r\n", 1)[0].split()
+            conn["status"] = int(parts[1]) if len(parts) > 1 else 0
+            conn["in_body"] = True
+            conn["head"] = bytearray()
+            if conn["status"] != 200:
+                self._drop(conn, reconnect=False)
+                return
+            data = rest
+            if not data:
+                return
+        now_wall = time.time()
+        frames = conn["parser"].feed(data)
+        with self._lock:
+            for frame in frames:
+                if (self.drop_one_delta and not conn["dropped"]
+                        and frame.get("type") == "delta"):
+                    # NEGATIVE CONTROL: a lost delta the client never
+                    # applied — the replay-equality invariant must flag
+                    # this subscriber.
+                    conn["dropped"] = True
+                    continue
+                conn["replay"].apply(frame, recv_wall=now_wall)
+                if frame.get("type") in ("delta", "full_sync"):
+                    lat = conn["replay"].last_latency_s
+                    if lat is not None:
+                        conn["latencies"].append(lat)
+
+    def _drop(self, conn, reconnect: bool = True) -> None:
+        if conn["closed"]:
+            return
+        conn["closed"] = True
+        try:
+            conn["worker"]["sel"].unregister(conn["sock"])
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn["sock"].close()
+        except OSError:
+            pass
+        with self._lock:
+            self.conns.pop(conn["id"], None)
+        if not reconnect or self._stopping:
+            return
+        # Reconnect to a LIVE peer: the kill degradation contract — a
+        # dead replica's viewers land on the survivors with a fresh
+        # snapshot; everyone else's stream is untouched.
+        from tpu_pod_exporter.stream import stream_path
+
+        with self._lock:
+            live = [(label, addr) for label, addr in self._endpoints
+                    if label not in self._dead_endpoints]
+        if not live:
+            return
+        label, addr = live[conn["id"] % len(live)]
+        cid = self._connect(conn["worker"], label, addr, conn["shape"],
+                            stream_path(conn["shape"]))
+        if cid is not None:
+            with self._lock:
+                self.conns[cid]["reconnects"] = conn["reconnects"] + 1
+
+    # ------------------------------------------------------------ snapshots
+
+    def live(self) -> int:
+        with self._lock:
+            return sum(1 for c in self.conns.values() if not c["closed"])
+
+    def wait_snapshots(self, n: int, timeout_s: float) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            with self._lock:
+                ready = sum(1 for c in self.conns.values()
+                            if c["replay"].seq is not None)
+            if ready >= n:
+                return True
+            time.sleep(0.05)
+        return False
+
+    def wait_caught_up(self, label_seqs: dict, timeout_s: float) -> int:
+        """Block until every live subscriber of each label has replayed
+        up to its SHAPE's current seq (``label_seqs``: label → the
+        endpoint hub's ``shape_seqs()``); returns the laggard count left
+        at timeout. Seq-based, not generation-based: a shape whose rows
+        did not change this round legitimately ships nothing."""
+        deadline = time.monotonic() + timeout_s
+        while True:
+            with self._lock:
+                lag = 0
+                for c in self.conns.values():
+                    if c["closed"] or c["replay"].seq is None:
+                        continue
+                    seqs = label_seqs.get(c["label"])
+                    if seqs is None:
+                        continue
+                    want = seqs.get(c["shape"].key)
+                    if want is not None and c["replay"].seq < want:
+                        lag += 1
+            if lag == 0 or time.monotonic() >= deadline:
+                return lag
+            time.sleep(0.02)
+
+    def sample(self, k: int):
+        """(label, shape, rows-by-key copy, generation) for k live,
+        synced subscribers — the replay-equality check's subjects."""
+        out = []
+        with self._lock:
+            for c in self.conns.values():
+                if c["closed"] or c["replay"].seq is None:
+                    continue
+                out.append((c["label"], c["shape"],
+                            dict(c["replay"].rows), c["replay"].generation))
+                if len(out) >= k:
+                    break
+        return out
+
+    def totals(self) -> dict:
+        with self._lock:
+            conns = list(self.conns.values())
+            return {
+                "live": sum(1 for c in conns if not c["closed"]),
+                "gaps": sum(c["replay"].gaps for c in conns),
+                "dups": sum(c["replay"].dups for c in conns),
+                "desynced": sum(1 for c in conns if c["replay"].desynced),
+                "reconnects": sum(c["reconnects"] for c in conns),
+                "sheds_seen": sum(
+                    1 for c in conns
+                    if c["replay"].shed_reason is not None),
+                "frames": sum(c["replay"].frames for c in conns),
+                "latencies": sorted(
+                    lat for c in conns for lat in c["latencies"]),
+            }
+
+    def drain_latencies(self) -> None:
+        with self._lock:
+            for c in self.conns.values():
+                c["latencies"] = []
+
+    def stop(self) -> None:
+        self._stopping = True
+        for w in self._workers:
+            try:
+                w["wake_w"].send(b"\x00")
+            except OSError:
+                pass
+        for w in self._workers:
+            w["thread"].join(timeout=10.0)
+
+
+def _raise_nofile(need: int) -> None:
+    try:
+        import resource
+
+        soft, hard = resource.getrlimit(resource.RLIMIT_NOFILE)
+        if soft < need <= hard:
+            resource.setrlimit(resource.RLIMIT_NOFILE, (hard, hard))
+    except (ImportError, ValueError, OSError):
+        pass
+
+
+def run_dashboard_demo(
+    n_targets: int,
+    shards: int,
+    chips: int,
+    subs: int,
+    rounds: int,
+    replicas: int,
+    state_root: str,
+    push_p99_budget_s: float = 1.0,
+    rss_cap_mb: float = 128.0,
+    negative: bool = False,
+    kill_replica: bool = True,
+) -> dict:
+    """The dashboard-storm acceptance drill (``make dashboard-demo``).
+
+    Holds ``subs`` concurrent stream subscriptions against one root +
+    ``replicas`` stateless read replicas over a real leaf tier, drives
+    caller-ticked rounds, and asserts: bounded per-round push p99, flat
+    RSS, zero duplicate/missed rounds per subscriber, delta replay equal
+    to the polled answer for every sampled subscriber every round, and a
+    replica kill mid-stream degrading ONLY its own subscribers (they
+    reconnect to a peer and resync). ``negative=True`` drops one delta
+    frame client-side per subscriber — the equality invariant must catch
+    it, proving the drill can fail."""
+    import os
+
+    from tpu_pod_exporter import utils
+    from tpu_pod_exporter.metrics import SnapshotBuilder
+    from tpu_pod_exporter.server import MetricsServer
+    from tpu_pod_exporter.shard import RootQueryPlane
+    from tpu_pod_exporter.stream import (
+        QueryShape,
+        StreamHub,
+        plane_poll_fn,
+        rows_map,
+    )
+
+    _raise_nofile(2 * subs + 4 * n_targets + 512)
+    os.makedirs(state_root, exist_ok=True)
+    result: dict = {
+        "ok": False, "mode": "dashboard", "targets": n_targets,
+        "shards": shards, "subs": subs, "rounds": rounds,
+        "replicas": replicas, "negative": negative,
+        "failures": [],
+    }
+    fails: list = result["failures"]
+    t_start = time.perf_counter()
+    # A dropped delta (negative mode) leaves its subscriber behind the
+    # round generation until the next frame — don't ride out the full
+    # production wait on a lag the control CREATED.
+    gen_wait_s = 5.0 if negative else 30.0
+    sim = _ShardSim(n_targets, shards, ha=False, chips=chips,
+                    state_root=state_root, timeout_s=10.0,
+                    query_plane=True)
+    # ~1/4 of the fleet's api series change per round: the delta stream
+    # has real sparsity to exploit (and to be measured on).
+    sim.farm.api_churn = 4
+    storm = _StormSubscribers(drop_one_delta=negative)
+    root_plane = RootQueryPlane(
+        sim.topology, timeout_s=10.5,
+        leaf_breakers=sim.root._breakers,
+        generation_fn=lambda: sim.root.rounds,
+    )
+    per_hub_cap = subs  # admission headroom; shed is exercised explicitly
+    root_hub = StreamHub(plane_poll_fn(root_plane),
+                         lambda: sim.root.rounds,
+                         heartbeat_s=5.0, full_sync_s=20.0,
+                         max_subscribers=per_hub_cap)
+    root_server = MetricsServer(sim.root_store, host="127.0.0.1", port=0,
+                                fleet=root_plane, stream_hub=root_hub)
+    root_server.start()
+    reps: list[_ReplicaSim] = []
+    try:
+        for i in range(replicas):
+            reps.append(_ReplicaSim(
+                f"replica-{i}", sim.topology,
+                root_url=f"127.0.0.1:{root_server.port}",
+                timeout_s=10.0, max_subscribers=per_hub_cap))
+        endpoints = [("root", ("127.0.0.1", root_server.port))] + [
+            (rep.name, rep.addr) for rep in reps
+        ]
+        storm.set_endpoints(endpoints)
+        planes = {"root": plane_poll_fn(root_plane)}
+        hubs = {"root": root_hub}
+        for rep in reps:
+            planes[rep.name] = rep.poll_fn
+            hubs[rep.name] = rep.hub
+
+        def tick_all() -> dict:
+            sim.run_round()
+            for rep in reps:
+                rep.tick_round()
+            t0 = time.perf_counter()
+            root_hub.on_round(sim.root.rounds)
+            return {"root_push_s": time.perf_counter() - t0}
+
+        # Prime: two rounds before any viewer shows up.
+        tick_all()
+        tick_all()
+
+        # Dashboard panels: a handful of query shapes shared by thousands
+        # of subscribers — per round the plane evaluates each shape ONCE
+        # per serving endpoint, not once per viewer.
+        shapes = [
+            QueryShape(route="window_stats", metric="tpu_hbm_used_bytes",
+                       match=(("slice_name", f"slice-{i}"),), window_s=60.0)
+            for i in range(4)
+        ] + [QueryShape(route="window_stats", metric="tpu_hbm_used_bytes",
+                        window_s=60.0)]
+        storm.open(subs, shapes)
+        if not storm.wait_snapshots(subs, timeout_s=60.0):
+            fails.append(
+                f"only {storm.live()} of {subs} subscriptions "
+                f"reached their snapshot")
+        result["connected"] = storm.live()
+        # RSS baseline AFTER the subscriptions exist: the flat-RSS
+        # invariant hunts leaks DURING the storm (per-round growth), not
+        # the one-time footprint of 2×subs in-process sockets this
+        # single-process harness deliberately carries on both sides.
+        rss_before = utils.process_rss_bytes() or 0
+
+        kill_round = (rounds // 2
+                      if (kill_replica and reps and rounds > 0) else -1)
+        round_push_p99: list[float] = []
+        equality_checked = 0
+        equality_failures = 0
+        for r in range(rounds):
+            storm.drain_latencies()
+            tick_all()
+            expect = {label: hub.shape_seqs() for label, hub in hubs.items()}
+            laggards = storm.wait_caught_up(expect,
+                                            timeout_s=gen_wait_s)
+            if laggards:
+                fails.append(
+                    f"round {r}: {laggards} subscribers never caught up "
+                    f"to their shape's seq")
+            # Replay == polled answer, per sampled subscriber. Same
+            # generation + the generation-keyed plane cache ⇒ the polled
+            # answer is byte-identical to what the hub diffed from.
+            for label, shape, rows, gen in storm.sample(12):
+                env = planes[label](shape, gen or 0)
+                equality_checked += 1
+                if rows != rows_map(shape.route, env):
+                    equality_failures += 1
+                    fails.append(
+                        f"round {r}: replay != polled answer for a "
+                        f"{label} subscriber of {shape.metric} "
+                        f"{dict(shape.match)}")
+            tot = storm.totals()
+            lats = tot["latencies"]
+            if lats:
+                round_push_p99.append(lats[int(0.99 * (len(lats) - 1))])
+            if r == kill_round:
+                pre_kill = storm.totals()
+                victim = reps[0]
+                with storm._lock:
+                    victim_subs = sum(
+                        1 for c in storm.conns.values()
+                        if c["label"] == victim.name and not c["closed"])
+                storm.mark_dead(victim.name)
+                victim.kill()
+                result["replica_kill"] = {
+                    "victim": victim.name,
+                    "subscribers_at_kill": victim_subs,
+                    "reconnects_before": pre_kill["reconnects"],
+                }
+        # Post-kill: every orphaned subscriber must be back on a live
+        # peer with a fresh snapshot (degradation contained to the
+        # victim's own viewers).
+        if kill_round >= 0:
+            deadline = time.monotonic() + 30.0
+            while time.monotonic() < deadline:
+                tot = storm.totals()
+                if tot["live"] >= result["connected"]:
+                    break
+                time.sleep(0.1)
+            tot = storm.totals()
+            rk = result["replica_kill"]
+            rk["reconnects_after"] = tot["reconnects"]
+            rk["live_after"] = tot["live"]
+            if tot["live"] < result["connected"]:
+                fails.append(
+                    f"replica kill: only {tot['live']} of "
+                    f"{result['connected']} subscribers live after "
+                    f"reconnect window")
+            if tot["reconnects"] < rk["subscribers_at_kill"]:
+                fails.append(
+                    f"replica kill: {rk['subscribers_at_kill']} "
+                    f"subscribers orphaned but only {tot['reconnects']} "
+                    f"reconnected")
+            # Survivors' streams untouched: reconnect count equals the
+            # victim's subscriber count (no collateral drops).
+            if tot["reconnects"] > rk["subscribers_at_kill"] + max(
+                    2, rk["subscribers_at_kill"] // 10):
+                fails.append(
+                    f"replica kill: {tot['reconnects']} reconnects for "
+                    f"{rk['subscribers_at_kill']} orphaned subscribers — "
+                    f"survivors were disrupted too")
+            # One settle round so reconnected subscribers resync, then
+            # re-verify equality across every endpoint.
+            tick_all()
+            expect = {label: h.shape_seqs() for label, h in hubs.items()
+                      if label != reps[0].name}
+            storm.wait_caught_up(expect, timeout_s=gen_wait_s)
+            for label, shape, rows, gen in storm.sample(12):
+                env = planes[label](shape, gen or 0)
+                equality_checked += 1
+                if rows != rows_map(shape.route, env):
+                    equality_failures += 1
+                    fails.append(
+                        f"post-kill settle: replay != polled answer on "
+                        f"{label}")
+
+        # Subscriber-shed semantics: pressure on the root hub sheds the
+        # oldest half with a labeled shed frame; the shed viewers
+        # reconnect (to any live endpoint) and the counter records it.
+        root_subs_before = root_hub.subscribers
+        shed_n = root_hub.shed_oldest(0.5, reason="pressure")
+        time.sleep(0.5)
+        b = SnapshotBuilder()
+        root_hub.emit(b)
+        snap = b.build(timestamp=time.time())
+        shed_counted = snap.value("tpu_stream_sheds_total",
+                                  ("pressure",)) or 0.0
+        result["shed"] = {"root_subs_before": root_subs_before,
+                          "shed": shed_n, "counted": shed_counted}
+        if shed_n and shed_counted < shed_n:
+            fails.append(
+                f"shed {shed_n} subscribers but counter shows "
+                f"{shed_counted}")
+
+        # Pull baseline: what the same viewers would cost as polling —
+        # one keep-alive client hammering the polled route (generation-
+        # cache-hot, the PRE-inversion best case). The storm's per-round
+        # cost for comparison: one delta computation per shape plus one
+        # small write per subscriber.
+        import http.client
+
+        poll_path = ("/api/v1/window_stats?metric=tpu_hbm_used_bytes"
+                     "&window=60")
+        conn = http.client.HTTPConnection("127.0.0.1", root_server.port,
+                                          timeout=10)
+        pull_n = min(max(subs // 4, 50), 1000)
+        pull_bytes = 0
+        t0 = time.perf_counter()
+        try:
+            for _ in range(pull_n):
+                conn.request("GET", poll_path)
+                r_ = conn.getresponse()
+                pull_bytes += len(r_.read())
+        finally:
+            conn.close()
+        pull_took = time.perf_counter() - t0
+        result["pull_baseline"] = {
+            "requests": pull_n,
+            "qps_one_client": round(pull_n / max(pull_took, 1e-9), 1),
+            "bytes_per_answer": pull_bytes // max(pull_n, 1),
+            "note": ("full body per viewer per refresh, cache-hot; the "
+                     "push plane ships changed rows only, once per round "
+                     "per subscriber"),
+        }
+
+        tot = storm.totals()
+        rss_after = utils.process_rss_bytes() or 0
+        result["push_p99_s"] = (max(round_push_p99)
+                                if round_push_p99 else None)
+        result["push_p99_budget_s"] = push_p99_budget_s
+        result["gaps"] = tot["gaps"]
+        result["dups"] = tot["dups"]
+        result["desynced"] = tot["desynced"]
+        result["frames_delivered"] = tot["frames"]
+        result["equality_checked"] = equality_checked
+        result["equality_failures"] = equality_failures
+        result["rss_before_mb"] = round(rss_before / 2**20, 1)
+        result["rss_after_mb"] = round(rss_after / 2**20, 1)
+        result["rss_delta_mb"] = round((rss_after - rss_before) / 2**20, 1)
+        stats = root_hub.stats()
+        result["root_hub"] = stats
+        if negative:
+            # The negative control PASSES only by FAILING: dropped deltas
+            # must surface as equality failures (or explicit gaps).
+            if equality_failures == 0 and tot["gaps"] == 0:
+                fails.append(
+                    "NEGATIVE CONTROL: deltas were dropped client-side "
+                    "but no invariant caught it")
+            else:
+                result["ok"] = True
+                result["negative_detected"] = equality_failures + tot["gaps"]
+                result["took_s"] = round(time.perf_counter() - t_start, 3)
+                return result
+        if equality_failures:
+            pass  # already recorded per round
+        if tot["gaps"] or tot["dups"]:
+            fails.append(
+                f"seq discontinuities: {tot['gaps']} gaps, "
+                f"{tot['dups']} dups across subscribers")
+        if result["push_p99_s"] is not None and (
+                result["push_p99_s"] > push_p99_budget_s):
+            fails.append(
+                f"per-round push p99 {result['push_p99_s']:.3f}s over "
+                f"budget {push_p99_budget_s}s")
+        if result["rss_delta_mb"] > rss_cap_mb:
+            fails.append(
+                f"RSS grew {result['rss_delta_mb']} MiB under the storm "
+                f"(cap {rss_cap_mb})")
+        result["ok"] = not fails
+        result["took_s"] = round(time.perf_counter() - t_start, 3)
+        return result
+    finally:
+        storm.stop()
+        root_server.stop()
+        root_hub.close()
+        root_plane.close()
+        for rep in reps:
+            rep.close()
+        sim.close()
+        try:
+            with open(os.path.join(state_root, "dashboard-result.json"),
+                      "w", encoding="utf-8") as f:
+                json.dump(result, f, indent=1, default=str)
+        except OSError:
+            pass
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(
         prog="tpu-loadgen-fleet",
@@ -1281,9 +2039,12 @@ def main(argv: list[str] | None = None) -> int:
                     "query plane (make fleet-query-demo) and the sharded "
                     "HA aggregation tree (make shard-demo).",
     )
-    p.add_argument("--mode", default="query", choices=("query", "shard"),
+    p.add_argument("--mode", default="query",
+                   choices=("query", "shard", "dashboard"),
                    help="query = fleet-query demo (default); shard = "
-                        "sharded-tree churn/kill demo")
+                        "sharded-tree churn/kill demo; dashboard = "
+                        "streaming viewer-storm drill (subscriptions vs "
+                        "one root + N read replicas)")
     p.add_argument("--shards", type=int, default=8,
                    help="[shard] consistent-hash shard count")
     p.add_argument("--no-ha", dest="ha", action="store_false", default=True,
@@ -1316,7 +2077,53 @@ def main(argv: list[str] | None = None) -> int:
                    help="skip the mid-run target kill")
     p.add_argument("--no-persist", dest="persist", action="store_false",
                    default=True, help="disable per-target persistence")
+    p.add_argument("--subs", type=int, default=5000,
+                   help="[dashboard] concurrent stream subscriptions")
+    p.add_argument("--replicas", type=int, default=2,
+                   help="[dashboard] stateless read replicas beside the "
+                        "root")
+    p.add_argument("--rounds", type=int, default=10,
+                   help="[dashboard] storm rounds to drive")
+    p.add_argument("--push-p99-budget-s", type=float, default=1.0,
+                   help="[dashboard] per-round push latency p99 budget")
+    p.add_argument("--rss-cap-mb", type=float, default=128.0,
+                   help="[dashboard] max RSS growth under the storm")
+    p.add_argument("--negative", action="store_true",
+                   help="[dashboard] NEGATIVE CONTROL: drop one delta "
+                        "frame per subscriber client-side; the run "
+                        "passes only if the replay-equality invariant "
+                        "catches it")
+    p.add_argument("--no-replica-kill", dest="replica_kill",
+                   action="store_false", default=True,
+                   help="[dashboard] skip the mid-storm replica kill")
     ns = p.parse_args(argv)
+
+    if ns.mode == "dashboard":
+        result = run_dashboard_demo(
+            ns.targets, ns.shards, ns.chips, ns.subs, ns.rounds,
+            ns.replicas, ns.state_root,
+            push_p99_budget_s=ns.push_p99_budget_s,
+            rss_cap_mb=ns.rss_cap_mb, negative=ns.negative,
+            kill_replica=ns.replica_kill,
+        )
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "root_hub"}, indent=1, default=str))
+        if not result["ok"]:
+            print(f"DASHBOARD DEMO FAILED: {result['failures']}",
+                  file=sys.stderr)
+            return 1
+        mode = "negative control" if ns.negative else "storm"
+        print(
+            f"dashboard-demo OK ({mode}): {result['connected']} "
+            f"subscriptions vs 1 root + {ns.replicas} replica(s) at "
+            f"{ns.targets} targets, {result['frames_delivered']} frames, "
+            f"push p99 {result['push_p99_s']}s "
+            f"(budget {ns.push_p99_budget_s}s), gaps {result['gaps']}, "
+            f"dups {result['dups']}, RSS {result['rss_delta_mb']:+} MiB, "
+            f"equality {result['equality_checked']} checks / "
+            f"{result['equality_failures']} failures"
+        )
+        return 0
 
     if ns.mode == "shard":
         result = run_shard_demo(

@@ -93,10 +93,16 @@ class _Run:
     STORE_TIERS = "0.25:600,2.5:600"
     STORE_FINEST_STEP = 0.25
 
+    # Per-round push-latency p99 budget for the dashboard_storm drill
+    # (frame emission ts → in-process subscriber receipt; generous for
+    # shared CI runners — the demo harness measures the real number).
+    DASH_PUSH_P99_BUDGET_S = 2.5
+
     def __init__(self, scn: Scenario, n_targets: int, shards: int,
                  chips: int, state_root: str, seed: int,
                  stale_serve_s: float = 30.0,
-                 governor: bool = True, store: bool = True) -> None:
+                 governor: bool = True, store: bool = True,
+                 stream: bool = True) -> None:
         from tpu_pod_exporter.egress import (
             RemoteWriteShipper,
             aggregator_egress_metrics,
@@ -141,11 +147,37 @@ class _Run:
         # self.sim.root LATE (lambdas): a root_restart event swaps the
         # root instance mid-run.
         self.governor_on = governor
+        # Streaming dashboard hub (dashboard_storm drill): answers
+        # through self.plane via the late-deref poll below, so a
+        # root_restart's plane rebuild is transparent to live streams.
+        # --stream off is the drill's NEGATIVE CONTROL: subscriptions
+        # 404 and the drill must fail.
+        self.stream_on = stream
+        self.hub = None
+        if stream:
+            from tpu_pod_exporter.stream import StreamHub
+
+            self.hub = StreamHub(
+                self._stream_poll, lambda: self.sim.root.rounds,
+                heartbeat_s=2.0, full_sync_s=6.0, max_subscribers=4096,
+            )
+        # Admission caps: the scrape_storm drill's tight bounds, EXCEPT
+        # when this scenario holds a dashboard storm — viewers are the
+        # workload there, and all its in-process subscribers share one
+        # source IP (the hub's subscriber cap is their admission story).
+        conn_cap = self.STORM_CONN_CAP if governor else 0
+        client_cap = self.STORM_CLIENT_CAP if governor else 0
+        dash_counts = [ev.count for ev in self.events
+                       if ev.kind == "dashboard_storm"]
+        if dash_counts and governor:
+            conn_cap = max(conn_cap, 2 * max(dash_counts) + 16)
+            client_cap = 0
         self.root_server = MetricsServer(
             self.sim.root_store, host="127.0.0.1", port=0,
             ready_detail_fn=lambda: self.sim.root.ready_detail(),
-            max_open_connections=self.STORM_CONN_CAP if governor else 0,
-            max_requests_per_client=self.STORM_CLIENT_CAP if governor else 0,
+            max_open_connections=conn_cap,
+            max_requests_per_client=client_cap,
+            stream_hub=self.hub,
         )
         self.root_server.start()
         # Two-level query plane, partitioned at the root→leaf seam.
@@ -234,6 +266,13 @@ class _Run:
                 lambda: self.sim.root.shed_stale_views(),
                 lambda: None,
             )
+            if self.hub is not None:
+                # Viewers shed LAST among the cheap rungs: dropping a
+                # cache costs a re-fan-out; dropping a subscription costs
+                # a viewer (who must reconnect against a replica).
+                from tpu_pod_exporter.pressure import register_stream_rung
+
+                register_stream_rung(self.gov, self.hub)
         # Pressure-drill state.
         self.disk_usage_at_squeeze = 0
         self.disk_budget_target = 0
@@ -242,6 +281,13 @@ class _Run:
         self.storm: ScrapeStorm | None = None
         self.storm_baseline_p99: float | None = None
         self.storm_p99s: list[float] = []
+        # dashboard_storm state: the subscriber harness plus running
+        # equality/latency tallies (verdict rendered in _finish).
+        self.dash = None
+        self.dash_eq_checks = 0
+        self.dash_eq_failures = 0
+        self.dash_push_p99s: list[float] = []
+        self.dash_totals: dict = {}
         self._polite_conn = None  # lazy http.client keep-alive connection
         self.baseline_series: set | None = None
         self.baseline_workloads = 0
@@ -328,6 +374,33 @@ class _Run:
             self.plane = StoreQueryPlane(inner, self.store)
         else:
             self.plane = inner
+        if self.hub is not None and (
+                self.hub.emit not in self.sim.root.emit_hooks):
+            # The tpu_stream_* surface rides the root's publish; a
+            # root_restart's fresh root needs the hook re-attached.
+            self.sim.root.emit_hooks.append(self.hub.emit)
+
+    # --------------------------------------------------------- stream helpers
+
+    def _stream_poll(self, shape, generation):
+        """The hub's poll_fn: answers through the CURRENT query plane
+        (late deref — root_restart rebuilds self.plane mid-run and live
+        streams must follow the fresh instance)."""
+        from tpu_pod_exporter.stream import plane_poll_fn
+
+        return plane_poll_fn(self.plane)(shape, generation)
+
+    def _dash_shapes(self):
+        from tpu_pod_exporter.stream import QueryShape
+
+        # One panel per farm slice plus a fleet-wide one — a handful of
+        # shapes shared by many subscribers, the dashboard's real shape.
+        return [
+            QueryShape(route="window_stats", metric="tpu_hbm_used_bytes",
+                       match=(("slice_name", f"slice-{i}"),), window_s=30.0)
+            for i in range(4)
+        ] + [QueryShape(route="window_stats",
+                        metric="tpu_hbm_used_bytes", window_s=30.0)]
 
     # ------------------------------------------------------- pressure helpers
 
@@ -481,6 +554,17 @@ class _Run:
             # stops appending — the dead window the store must later fill.
             self.kill_wall = time.time()
             self.sim.kill_root()
+        elif ev.kind == "dashboard_storm":
+            from tpu_pod_exporter.loadgen.fleet import _StormSubscribers
+
+            self.dash = _StormSubscribers(workers=2)
+            self.dash.set_endpoints(
+                [("root", ("127.0.0.1", self.root_server.port))])
+            self.dash.open(ev.count, self._dash_shapes())
+            # With --stream off this wait times out (every subscribe
+            # 404s) and the tick invariant below fails the run — the
+            # negative control's whole point.
+            self.dash.wait_snapshots(ev.count, timeout_s=10.0)
 
     def _end_event(self, ev: ScenarioEvent) -> None:
         farm = self.sim.farm
@@ -524,6 +608,11 @@ class _Run:
             self.sim.restart_root()
             self.restart_wall = time.time()
             self._build_planes()
+        elif ev.kind == "dashboard_storm":
+            if self.dash is not None:
+                self.dash_totals = self.dash.totals()
+                self.dash.stop()
+                self.dash = None
 
     def _tick_event(self, ev: ScenarioEvent, r: int) -> None:
         """Per-round continuation for windowed events."""
@@ -592,6 +681,10 @@ class _Run:
                 self.sim.run_round()
                 if self.shipper is not None:
                     self.shipper.on_snapshot(self.sim.root_store.current())
+                if self.hub is not None:
+                    # Deterministic engine: rounds drive the hub
+                    # synchronously (the CLIs ride a StreamPump thread).
+                    self.hub.on_round(self.sim.root.rounds)
                 if self.gov is not None:
                     # Two synchronous ticks: at most one rung moves per
                     # tick, and the deeper ladders need to climb within a
@@ -918,6 +1011,8 @@ class _Run:
                             f"r{r}: polite scrape p99 {1e3 * worst:.1f}ms "
                             f"during the storm vs {1e3 * base:.1f}ms "
                             f"baseline — serving latency not protected")
+            elif ev.kind == "dashboard_storm":
+                problems.extend(self._check_dashboard_tick(ev, r, fams))
 
         self.problems.extend(problems)
         self.trace.append({
@@ -939,6 +1034,60 @@ class _Run:
             "series": len(series),
             "problems": problems,
         })
+
+    def _check_dashboard_tick(self, ev, r: int, fams) -> list[str]:
+        """dashboard_storm per-tick invariants: every subscriber live and
+        caught up to this round's generation, delta replay == the polled
+        answer at the SAME generation for sampled subscribers, zero seq
+        gaps/dups, and the subscription load attributable from the
+        tpu_stream_* exposition."""
+        from tpu_pod_exporter.stream import rows_map
+
+        problems: list[str] = []
+        dash = self.dash
+        if dash is None:
+            return problems
+        live = dash.live()
+        if live < ev.count:
+            problems.append(
+                f"r{r}: only {live}/{ev.count} dashboard subscriptions "
+                f"live" + ("" if self.stream_on else
+                           " (stream disabled — negative control)"))
+            return problems
+        lag = dash.wait_caught_up({"root": self.hub.shape_seqs()},
+                                  timeout_s=10.0)
+        if lag:
+            problems.append(
+                f"r{r}: {lag} subscribers never caught up to their "
+                f"shape's seq this round")
+        for label, shape, rows, sgen in dash.sample(10):
+            self.dash_eq_checks += 1
+            env = self._stream_poll(shape, sgen or 0)
+            if rows != rows_map(shape.route, env):
+                self.dash_eq_failures += 1
+                problems.append(
+                    f"r{r}: delta replay != polled answer for a {label} "
+                    f"subscriber of {shape.metric} {dict(shape.match)}")
+        tot = dash.totals()
+        if tot["gaps"] or tot["dups"]:
+            problems.append(
+                f"r{r}: stream seq discontinuities — {tot['gaps']} gaps, "
+                f"{tot['dups']} dups")
+        if tot["latencies"]:
+            lats = tot["latencies"]
+            self.dash_push_p99s.append(lats[int(0.99 * (len(lats) - 1))])
+        dash.drain_latencies()
+        # Attributable from the exposition alone: the hub's surface rides
+        # the root publish (tpu_stream_subscribers is the gauge the
+        # RUNBOOK's storm playbook reads first).
+        subs_series = fams.get(schema.TPU_STREAM_SUBSCRIBERS.name, ())
+        gauge = subs_series[0].value if subs_series else None
+        if r > ev.at_round and (gauge is None or gauge < 0.9 * ev.count):
+            problems.append(
+                f"r{r}: tpu_stream_subscribers reads {gauge!r} with "
+                f"{live} live subscriptions — storm not attributable "
+                f"from the exposition")
+        return problems
 
     def _egress_exposition(self) -> dict[str, float]:
         """The shipper's self-metric surface AS EXPOSITION (the same
@@ -1025,6 +1174,29 @@ class _Run:
                 "memory": {k: gs["memory"][k]
                            for k in ("level", "sheds", "recovers")},
             }
+        if any(ev.kind == "dashboard_storm" for ev in self.events):
+            tot = self.dash_totals
+            push_p99 = (max(self.dash_push_p99s)
+                        if self.dash_push_p99s else None)
+            result["dashboard"] = {
+                "frames": tot.get("frames"),
+                "gaps": tot.get("gaps"),
+                "dups": tot.get("dups"),
+                "push_p99_s": push_p99,
+                "push_p99_budget_s": self.DASH_PUSH_P99_BUDGET_S,
+                "eq_checks": self.dash_eq_checks,
+                "eq_failures": self.dash_eq_failures,
+            }
+            if self.stream_on and self.dash_eq_checks == 0:
+                self.problems.append(
+                    "dashboard_storm window produced ZERO replay-equality "
+                    "checks — the invariant never ran")
+            if push_p99 is not None and (
+                    push_p99 > self.DASH_PUSH_P99_BUDGET_S):
+                self.problems.append(
+                    f"dashboard push p99 {push_p99:.3f}s over the "
+                    f"{self.DASH_PUSH_P99_BUDGET_S}s budget")
+
         if not recovered:
             self.problems.append(
                 "stack did not converge back to healthy + oracle-equal "
@@ -1256,12 +1428,16 @@ class _Run:
     def _close(self) -> None:
         if self.storm is not None:
             self.storm.stop()
+        if self.dash is not None:
+            self.dash.stop()
         if self._polite_conn is not None:
             self._polite_conn.close()
         try:
             self.root_server.stop()
         except Exception:  # noqa: BLE001 — teardown must finish
             pass
+        if self.hub is not None:
+            self.hub.close()
         self.plane.close()
         if self.shipper is not None:
             self.shipper.close()
@@ -1272,17 +1448,20 @@ class _Run:
 
 def run_scenarios(names: list[str], n_targets: int, shards: int,
                   chips: int, state_root: str, seed: int,
-                  governor: bool = True, store: bool = True) -> dict:
+                  governor: bool = True, store: bool = True,
+                  stream: bool = True) -> dict:
     """Run the named scenarios back to back, each on a fresh stack (own
     state dir under ``state_root``); returns the summary dict the demo
     prints and writes as the CI artifact. ``governor=False`` is the
-    pressure drills' negative control and ``store=False`` the
-    store-continuity drill's: the invariants still run, and the run is
-    EXPECTED to fail them."""
+    pressure drills' negative control, ``store=False`` the
+    store-continuity drill's, and ``stream=False`` the dashboard-storm
+    drill's: the invariants still run, and the run is EXPECTED to fail
+    them."""
     os.makedirs(state_root, exist_ok=True)
     summary: dict = {
         "ok": True, "targets": n_targets, "shards": shards,
         "seed": seed, "governor": governor, "store": store,
+        "stream": stream,
         "scenarios": {},
     }
     all_traces: dict[str, list] = {}
@@ -1291,7 +1470,7 @@ def run_scenarios(names: list[str], n_targets: int, shards: int,
         t0 = time.monotonic()
         run = _Run(scn, n_targets, shards, chips,
                    os.path.join(state_root, name), seed,
-                   governor=governor, store=store)
+                   governor=governor, store=store, stream=stream)
         result = run.run()
         result["wall_s"] = round(time.monotonic() - t0, 2)
         all_traces[name] = run.trace
@@ -1350,6 +1529,12 @@ def main(argv: list[str] | None = None) -> int:
                         "boundary-gap invariant still runs and the drill "
                         "is expected to FAIL (CI asserts the non-zero "
                         "exit)")
+    p.add_argument("--stream", default="on", choices=("on", "off"),
+                   help="off = the dashboard_storm drill's NEGATIVE "
+                        "CONTROL: no stream hub on the root — the "
+                        "subscriptions cannot register, the invariants "
+                        "still run and the drill is expected to FAIL "
+                        "(CI asserts the non-zero exit)")
     p.add_argument("--log-level", default="warning")
     ns = p.parse_args(argv)
     _utils.setup_logging(ns.log_level)
@@ -1376,7 +1561,8 @@ def main(argv: list[str] | None = None) -> int:
     summary = run_scenarios(names, ns.targets, ns.shards, ns.chips,
                             ns.state_root, ns.seed,
                             governor=ns.governor == "on",
-                            store=ns.store == "on")
+                            store=ns.store == "on",
+                            stream=ns.stream == "on")
     if not summary["ok"]:
         failed = [n for n, r in summary["scenarios"].items()
                   if not r["ok"]]

@@ -1381,6 +1381,100 @@ STORE_SPECS: tuple[MetricSpec, ...] = (
     TPU_ROOT_STORE_THINNED,
 )
 
+# --- Streaming dashboard plane (tpu_pod_exporter.stream) ---------------------
+# Emitted only while a StreamHub is attached to the serving tier
+# (aggregator, root, or replica) — conditional surface, same rule as
+# FLEET_QUERY_SPECS. The plane's health must be auditable from the
+# exposition alone: subscriber churn, frames pushed by type, shed
+# subscriptions by reason, and per-round push latency.
+
+TPU_STREAM_SUBSCRIBERS = MetricSpec(
+    name="tpu_stream_subscribers",
+    help="Live dashboard stream subscriptions currently attached to this tier's /api/v1/stream endpoint (SSE connections; long-poll waiters are transient and not counted here).",
+    type=GAUGE,
+)
+
+TPU_STREAM_QUERY_SHAPES = MetricSpec(
+    name="tpu_stream_query_shapes",
+    help="Distinct registered query shapes the stream hub computes per round. Each shape costs ONE delta computation per round regardless of how many subscribers share it — the fan-out inversion's whole point.",
+    type=GAUGE,
+)
+
+TPU_STREAM_SUBSCRIBES_TOTAL = MetricSpec(
+    name="tpu_stream_subscribes_total",
+    help="Stream subscriptions accepted since start, by transport (sse | longpoll; long-poll counts one per held request).",
+    type=COUNTER,
+    label_names=("transport",),
+)
+
+TPU_STREAM_REJECTS_TOTAL = MetricSpec(
+    name="tpu_stream_rejects_total",
+    help="Stream subscriptions refused since start, by cause: 'cap' (subscriber cap reached — the admission half of the pressure story; clients get a 429 and should retry against a replica).",
+    type=COUNTER,
+    label_names=("cause",),
+)
+
+TPU_STREAM_FRAMES_TOTAL = MetricSpec(
+    name="tpu_stream_frames_total",
+    help="Frames pushed to subscribers since start, by type: snapshot (registration answer), delta (changed series only), full_sync (periodic anti-rot full answer), heartbeat.",
+    type=COUNTER,
+    label_names=("type",),
+)
+
+TPU_STREAM_FRAME_BYTES_TOTAL = MetricSpec(
+    name="tpu_stream_frame_bytes_total",
+    help="Wire bytes of frames pushed to subscribers since start (serialized once per shape per round, counted once per subscriber write).",
+    type=COUNTER,
+)
+
+TPU_STREAM_SHEDS_TOTAL = MetricSpec(
+    name="tpu_stream_sheds_total",
+    help="Live subscriptions closed by the server since start, by reason: 'pressure' (the memory ladder's stream_shed rung dropped the oldest half), 'slow' (a subscriber's pending write buffer exceeded the cap), 'cap' (oldest shed to admit pressure-exempt work).",
+    type=COUNTER,
+    label_names=("reason",),
+)
+
+TPU_STREAM_PUSH_SECONDS = HistogramSpec(
+    name="tpu_stream_push_seconds",
+    help="Per-round push latency per query shape: delta computation plus handing every subscriber's frame to the event loop (socket flush is asynchronous and bounded by the write-progress deadline). The dashboard-storm drill's p99 budget reads this.",
+    buckets=POLL_DURATION_BUCKETS,
+)
+
+STREAM_SPECS: tuple[MetricSpec, ...] = (
+    TPU_STREAM_SUBSCRIBERS,
+    TPU_STREAM_QUERY_SHAPES,
+    TPU_STREAM_SUBSCRIBES_TOTAL,
+    TPU_STREAM_REJECTS_TOTAL,
+    TPU_STREAM_FRAMES_TOTAL,
+    TPU_STREAM_FRAME_BYTES_TOTAL,
+    TPU_STREAM_SHEDS_TOTAL,
+)
+
+# --- Stateless root read replicas (tpu-pod-exporter-shard --role replica) ----
+# A replica scrapes the leaves read-only exactly like the root and serves
+# /metrics + /api/v1 + /api/v1/stream, but owns no egress, no persistence
+# and no store writes — viewer fan-out scales by adding replicas while
+# exactly one root keeps the write-side duties.
+
+TPU_REPLICA_INFO = MetricSpec(
+    name="tpu_replica_info",
+    help="Identity of this stateless read replica (value always 1). Present only on --role replica tiers; its absence from a /metrics body is how you know you are talking to the real root.",
+    type=GAUGE,
+    label_names=("replica",),
+)
+
+TPU_REPLICA_STORE_PROXIED_TOTAL = MetricSpec(
+    name="tpu_replica_store_proxied_total",
+    help="?source= store queries this replica forwarded to the root's store (--root-url), by result (ok | error). Replicas own no store; without --root-url these queries 400 honestly instead.",
+    type=COUNTER,
+    label_names=("result",),
+)
+
+REPLICA_SPECS: tuple[MetricSpec, ...] = (
+    TPU_REPLICA_INFO,
+    TPU_REPLICA_STORE_PROXIED_TOTAL,
+)
+
 # The rollup surface the aggregator's remote-write egress ships
 # (tpu_pod_exporter.egress): the slice/multislice/workload rollups plus
 # per-target up — the "what is the fleet doing" set a central TSDB wants,

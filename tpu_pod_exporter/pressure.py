@@ -604,6 +604,69 @@ def register_store_rungs(
     store.set_pressure_hook(gov.report_io_error)
 
 
+# ----------------------------------------------------- stream-hub wiring
+
+
+def register_stream_rung(
+    gov: PressureGovernor, hub: Any,
+    hub_fn: Callable[[], Any] | None = None,
+) -> None:
+    """Wire a streaming dashboard hub (tpu_pod_exporter.stream.StreamHub)
+    into the memory ladder: the ``stream_shed`` rung sheds the OLDEST
+    half of the live subscriptions (each gets a final ``shed`` frame and
+    a counted ``tpu_stream_sheds_total{reason="pressure"}``) and halves
+    the effective subscriber cap so a storm cannot instantly refill what
+    was shed; recovery restores the configured cap. Ordered after the
+    fleet-cache rung by registration order in the harnesses: dropping a
+    cache is cheaper than dropping viewers, so viewers shed last among
+    the cheap rungs but before history cuts. The hub's retained bytes
+    (last answers + catch-up rings) register as a memory component — the
+    shed decision and /debug/vars read the same number."""
+    get = hub_fn if hub_fn is not None else (lambda: hub)
+    gov.register_memory_component("stream",
+                                  lambda: int(get().memory_bytes()))
+    gov.add_memory_rung(
+        "stream_shed",
+        lambda: get().apply_pressure(),
+        lambda: get().release_pressure(),
+    )
+
+
+def build_serving_governor(
+    memory_budget_bytes: int,
+    sidecar_dir: str = "",
+    cache_plane: Any = None,
+    hub: Any = None,
+    governor: "PressureGovernor | None" = None,
+) -> "PressureGovernor | None":
+    """The serving-tier memory ladder the CLIs share (flat aggregator,
+    root, replica — ``--memory-budget-mb``): the query-plane result
+    cache sheds FIRST (queries re-fan-out; pure speed, zero viewers
+    lost), live stream subscriptions LAST via :func:`register_stream_rung`
+    (dropping viewers costs reconnects). Extends ``governor`` when the
+    tier already built one (the root's store disk budget) — one governor
+    per process — else builds and STARTS a fresh one. Returns the
+    governor (unchanged when no budget is configured)."""
+    if memory_budget_bytes <= 0:
+        return governor
+    gov = governor if governor is not None else PressureGovernor(
+        sidecar_dir=sidecar_dir)
+    gov.set_memory_budget_bytes(memory_budget_bytes)
+    if cache_plane is not None and hasattr(cache_plane, "cache_bytes"):
+        gov.register_memory_component(
+            "fleet_cache", lambda: int(cache_plane.cache_bytes()))
+        gov.add_memory_rung(
+            "fleet_cache",
+            lambda: cache_plane.set_cache_enabled(False),
+            lambda: cache_plane.set_cache_enabled(True),
+        )
+    if hub is not None:
+        register_stream_rung(gov, hub)
+    if governor is None:
+        gov.start()
+    return gov
+
+
 # --------------------------------------------------------------------- demo
 
 

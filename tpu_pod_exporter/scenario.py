@@ -79,6 +79,13 @@ EVENT_KINDS: tuple[str, ...] = (
     # attached) rebuilt on the same state dirs — the store-continuity
     # drill's boundary.
     "root_restart",
+    # Streaming dashboard kind (ISSUE 15): N stream subscriptions held
+    # against the root's /api/v1/stream for the window; per-tick
+    # invariants assert delta-replay == polled answer, zero seq gaps/
+    # dups, and bounded push latency. --stream off is the drill's
+    # negative control (the subscriptions cannot register; the run must
+    # fail).
+    "dashboard_storm",
 )
 
 TIERS: tuple[str, ...] = ("node", "leaf", "root", "recv")
@@ -276,6 +283,23 @@ def parse_event(raw: str) -> ScenarioEvent:
                             f"integer") from None
         if ev.count < 1:
             raise _err(raw, f"connection count {ev.count} must be >= 1")
+        return ev
+
+    if kind == "dashboard_storm":
+        if len(args) != 1:
+            raise _err(raw, "dashboard_storm wants exactly "
+                            "(N subscriptions)")
+        try:
+            ev.count = int(args[0])
+        except ValueError:
+            raise _err(raw, f"bad subscription count {args[0]!r}: want an "
+                            f"integer") from None
+        if ev.count < 1:
+            raise _err(raw, f"subscription count {ev.count} must be >= 1")
+        if ev.duration < 2:
+            raise _err(raw, "dashboard_storm needs +duration >= 2 — a "
+                            "one-round stream never receives a delta, so "
+                            "the replay invariant would assert nothing")
         return ev
 
     if kind == "clock_step":
@@ -513,6 +537,26 @@ SCENARIOS: dict[str, Scenario] = {
             ),
             settle_rounds=4,
             gpu_slices=2,
+        ),
+        Scenario(
+            name="dashboard_storm",
+            timeline=("dashboard_storm(192)@2+6; "
+                      "partition(leaf<->root, asymmetric)@4+2"),
+            description=(
+                "The streaming dashboard plane under viewer load WITH a "
+                "mid-stream partial partition: 192 subscriptions register "
+                "against the root's /api/v1/stream and ride per-round "
+                "deltas while the root loses one leaf of every HA pair. "
+                "Per tick: every sampled subscriber's delta replay equals "
+                "the polled answer at the same generation (bit for bit, "
+                "through the partition — streamed and polled viewers must "
+                "never disagree), zero seq gaps/dups across subscribers, "
+                "push latency bounded, and the subscription count "
+                "attributable from the tpu_stream_* exposition. With "
+                "--stream off the SAME drill must fail (subscriptions "
+                "cannot register) — the negative control CI asserts."
+            ),
+            settle_rounds=3,
         ),
         Scenario(
             name="recv_outage",

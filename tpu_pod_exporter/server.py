@@ -58,6 +58,7 @@ import socket
 import threading
 import time
 from collections import deque
+from itertools import islice
 from typing import Any, Callable
 from urllib.parse import parse_qs
 
@@ -159,10 +160,22 @@ _CONN_REJECT_RESPONSE = prerender_429(
 _CLIENT_REJECT_RESPONSE = prerender_429(
     b"per-client request limit reached\n", "text/plain; charset=utf-8"
 )
+# Stream-subscriber cap (the dashboard plane's admission half): a viewer
+# storm past the cap pays a pre-rendered 429 and should retry against a
+# read replica — tpu_stream_rejects_total{cause="cap"} counts it.
+_STREAM_REJECT_RESPONSE = prerender_429(
+    b'{"status": "error", "error": "stream subscriber cap reached; '
+    b'retry against a read replica"}',
+    "application/json",
+)
 
 # Probe paths exempt from admission control: a scrape storm must never be
 # able to 429 kubelet's liveness/readiness probes into restarting the pod.
 _ADMISSION_EXEMPT_PATHS = ("/healthz", "/readyz")
+
+# Scatter-gather writes need sendmsg (Linux/BSD; absent on some
+# platforms — the per-view send() path below is the fallback).
+_HAS_SENDMSG = hasattr(socket.socket, "sendmsg")
 
 
 def accepts_openmetrics(accept: str) -> bool:
@@ -355,6 +368,7 @@ class _Conn:
         "busy", "close_after", "closed", "client_key", "req_t0",
         "observe_scrape", "trace_ctx", "need_discard", "events",
         "response_pending", "last_write_progress", "write_deadline_armed",
+        "streaming", "stream_sub",
     )
 
     def __init__(self, sock: socket.socket, ip: str) -> None:
@@ -377,6 +391,11 @@ class _Conn:
         self.response_pending = False
         self.last_write_progress = 0.0
         self.write_deadline_armed = False
+        # Dashboard stream subscription riding this connection (SSE,
+        # close-delimited): the loop pushes frames instead of finishing a
+        # request, and closing detaches the hub subscriber.
+        self.streaming = False
+        self.stream_sub: Any = None
 
 
 class _WorkerPool:
@@ -388,12 +407,22 @@ class _WorkerPool:
 
     _IDLE_EXPIRE_S = 10.0
 
-    def __init__(self, max_workers: int) -> None:
+    def __init__(self, max_workers: int,
+                 idle_expire_s: float | None = None) -> None:
         self._max = max(1, max_workers)
+        self._idle_expire = (idle_expire_s if idle_expire_s is not None
+                             else self._IDLE_EXPIRE_S)
         self._tasks: deque[Callable[[], None]] = deque()
-        self._cv = threading.Condition(threading.Lock())
+        self._lock = threading.Lock()
+        # LIFO stack of idle workers' wake events. Submit wakes the MOST
+        # recently parked worker: work concentrates on few hot threads and
+        # the rest genuinely idle until the reap takes them. (The old
+        # Condition.notify() rotated wakeups round-robin through every
+        # waiter, which both refreshed each one's idle clock AND spread a
+        # trickle of tasks across the whole storm-grown pool — BENCH_r06's
+        # slow_clients threads_after never returned to baseline.)
+        self._waiters: list[threading.Event] = []
         self._threads = 0
-        self._idle = 0
         self._seq = 0
         self._stopping = False
 
@@ -406,51 +435,71 @@ class _WorkerPool:
         return len(self._tasks)
 
     def submit(self, fn: Callable[[], None]) -> None:
-        with self._cv:
+        spawn = False
+        wake: threading.Event | None = None
+        with self._lock:
             if self._stopping:
                 return
             self._tasks.append(fn)
-            # Spawn when the backlog exceeds the idle workers, not only
-            # when none are idle: a batch of submits landing while one
-            # worker is still in cv.wait would otherwise issue lost
-            # notify()s (one waiter absorbs one notify) and serialize the
-            # whole batch onto that single thread despite pool capacity.
-            if self._idle < len(self._tasks) and self._threads < self._max:
+            if self._waiters:
+                wake = self._waiters.pop()  # LIFO: hottest worker first
+            elif self._threads < self._max:
                 self._threads += 1
                 self._seq += 1
-                t = threading.Thread(
-                    target=self._run,
-                    name=f"tpu-exporter-http-worker-{self._seq}",
-                    daemon=True,
-                )
-                t.start()
-            self._cv.notify()
+                spawn = True
+                seq = self._seq
+        if wake is not None:
+            wake.set()
+        if spawn:
+            threading.Thread(
+                target=self._run,
+                name=f"tpu-exporter-http-worker-{seq}",
+                daemon=True,
+            ).start()
 
     def _run(self) -> None:
+        ev = threading.Event()
+        last_active = time.monotonic()
         while True:
-            with self._cv:
-                self._idle += 1
-                while not self._tasks and not self._stopping:
-                    if not self._cv.wait(timeout=self._IDLE_EXPIRE_S):
-                        if self._tasks or self._stopping:
-                            break
-                        self._idle -= 1
-                        self._threads -= 1
-                        return
-                self._idle -= 1
-                if not self._tasks:
+            fn: Callable[[], None] | None = None
+            with self._lock:
+                if self._tasks:
+                    fn = self._tasks.popleft()
+                elif self._stopping:
                     self._threads -= 1
                     return
-                fn = self._tasks.popleft()
+                else:
+                    idle_for = time.monotonic() - last_active
+                    if idle_for >= self._idle_expire:
+                        # This worker hasn't been needed for a full grace
+                        # period: the pool shrinks back toward the traffic
+                        # it actually has (steady state 0-1 workers).
+                        self._threads -= 1
+                        return
+                    ev.clear()
+                    self._waiters.append(ev)
+            if fn is None:
+                ev.wait(timeout=self._idle_expire
+                        - (time.monotonic() - last_active))
+                with self._lock:
+                    try:
+                        self._waiters.remove(ev)
+                    except ValueError:
+                        pass  # a submit popped us — a task is waiting
+                continue
             try:
                 fn()
             except Exception:  # noqa: BLE001 — a task must not kill the pool
                 log.exception("http worker task failed")
+            last_active = time.monotonic()
 
     def shutdown(self) -> None:
-        with self._cv:
+        with self._lock:
             self._stopping = True
-            self._cv.notify_all()
+            waiters = list(self._waiters)
+            self._waiters.clear()
+        for ev in waiters:
+            ev.set()
 
 
 class _HandlerState:
@@ -490,6 +539,10 @@ class _HandlerState:
         self.client_write_timeout_s: float = 10.0
         self.write_timeouts: dict[str, int] = {}
         self.write_timeouts_lock = threading.Lock()
+        # Streaming dashboard plane (tpu_pod_exporter.stream.StreamHub);
+        # None = /api/v1/stream answers 404 on this tier.
+        self.stream: Any = None
+        self.stream_max_buffer_bytes: int = 2 << 20
 
 
 class _CompatHandle:
@@ -507,7 +560,8 @@ class _EventLoopServer:
     exclusively through :meth:`call_soon` + the wake pipe."""
 
     def __init__(self, host: str, port: int, state: _HandlerState,
-                 max_workers: int) -> None:
+                 max_workers: int,
+                 worker_idle_expire_s: float = 10.0) -> None:
         self.state = state
         self._sel = selectors.DefaultSelector()
         lsock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
@@ -535,7 +589,7 @@ class _EventLoopServer:
         self._wake_r.setblocking(False)
         self._wake_w.setblocking(False)
         self._stopping = False
-        self.pool = _WorkerPool(max_workers)
+        self.pool = _WorkerPool(max_workers, worker_idle_expire_s)
         self.served = {"inline": 0, "worker": 0}
         self._sel.register(lsock, selectors.EVENT_READ, None)
         self._sel.register(self._wake_r, selectors.EVENT_READ, None)
@@ -675,6 +729,15 @@ class _EventLoopServer:
         except (KeyError, ValueError, OSError):
             pass
         conn.closed = True
+        sub = conn.stream_sub
+        if sub is not None:
+            conn.stream_sub = None
+            hub = self.state.stream
+            if hub is not None:
+                try:
+                    hub.detach(sub)
+                except Exception:  # noqa: BLE001 — teardown must not kill the loop
+                    log.exception("stream detach failed")
         self._release_client_slot(conn)
         self._conns.pop(conn.fd, None)
         try:
@@ -710,6 +773,12 @@ class _EventLoopServer:
             return
         if not data:
             self._close_conn(conn)
+            return
+        if conn.streaming:
+            # A subscriber never pipelines; anything it sends after the
+            # subscribe request is discarded. The read interest stays on
+            # solely so a client close is noticed promptly (the recv above
+            # returning b"" is how a dropped viewer frees its slot).
             return
         conn.rbuf += data
         if conn.busy:
@@ -825,12 +894,28 @@ class _EventLoopServer:
         conn.need_discard = 0
         self._set_events(conn, conn.events & ~selectors.EVENT_READ)
 
+    # Scatter-gather width for sendmsg: response head + body leave in one
+    # syscall (the identity keep-alive fast path — a ~1 MB cached body was
+    # previously one send per queued view, and the head/body split cost a
+    # second syscall per request); bounded well under IOV_MAX.
+    _SENDMSG_MAX_VIEWS = 16
+
     def _try_write(self, conn: _Conn) -> None:
         sock = conn.sock
         while conn.wbufs:
-            mv = conn.wbufs[0]
             try:
-                n = sock.send(mv)
+                if len(conn.wbufs) > 1 and _HAS_SENDMSG:
+                    # Zero-copy gather of the queued memoryviews — no
+                    # join, no intermediate bytes; the kernel walks the
+                    # iovec straight out of the cached body. islice, not
+                    # a full-deque copy: a backlogged stream subscriber
+                    # can hold hundreds of queued frame views, and this
+                    # runs on the loop's hot path.
+                    bufs = list(islice(conn.wbufs,
+                                       self._SENDMSG_MAX_VIEWS))
+                    n = sock.sendmsg(bufs)
+                else:
+                    n = sock.send(conn.wbufs[0])
             except BlockingIOError:
                 self._set_events(conn, conn.events | selectors.EVENT_WRITE)
                 self._arm_write_deadline(conn)
@@ -838,12 +923,18 @@ class _EventLoopServer:
             except OSError:
                 self._close_conn(conn)
                 return
-            if n:
-                conn.last_write_progress = time.monotonic()
-            if n < len(mv):
-                conn.wbufs[0] = mv[n:]
-            else:
-                conn.wbufs.popleft()
+            if not n:
+                break
+            conn.last_write_progress = time.monotonic()
+            # Advance the queue by n bytes (sendmsg may span views).
+            while n:
+                mv = conn.wbufs[0]
+                if n < len(mv):
+                    conn.wbufs[0] = mv[n:]
+                    n = 0
+                else:
+                    n -= len(mv)
+                    conn.wbufs.popleft()
         if conn.events & selectors.EVENT_WRITE:
             self._set_events(conn, conn.events & ~selectors.EVENT_WRITE)
         if conn.response_pending:
@@ -916,6 +1007,190 @@ class _EventLoopServer:
         if not conn.closed and not conn.busy:
             self._process_rbuf(conn)
 
+    # ----------------------------------------------------------- streaming
+
+    _STREAM_HEAD = (
+        b"HTTP/1.1 200 OK\r\n"
+        b"Content-Type: text/event-stream\r\n"
+        b"Cache-Control: no-cache\r\n"
+        b"X-Accel-Buffering: no\r\n"
+        b"Connection: close\r\n"
+        b"\r\n"
+    )
+
+    def _begin_stream(self, conn: _Conn, sub: Any, payload: bytes) -> None:
+        """Loop-thread: turn this connection into a live SSE subscription.
+        ``payload`` is the hub-built snapshot (plus any frames that landed
+        during serialization); everything after arrives via
+        :meth:`_stream_write` posts from round/tick threads."""
+        hub = self.state.stream
+        if conn.closed:
+            # The viewer dropped while the worker was subscribing.
+            if hub is not None:
+                hub.detach(sub)
+            return
+        conn.streaming = True
+        conn.stream_sub = sub
+        conn.keep_alive = False
+        conn.close_after = False    # pushes keep coming until detach
+        conn.response_pending = False
+        # The subscription is capped by the hub, not the per-client
+        # request cap — a dashboard opening 8 panels from one IP is the
+        # normal case, not an attack the request cap should stop.
+        self._release_client_slot(conn)
+        conn.wbufs.append(memoryview(self._STREAM_HEAD))
+        conn.wbufs.append(memoryview(payload))
+        # Transport is ready: enable round pushes AND atomically collect
+        # any frame committed since the snapshot was built (the ring
+        # catch-up) — writer() posts land on this loop strictly after
+        # this callback, so a frame is never dropped into the
+        # pre-streaming window and never duplicated.
+        if hub is not None:
+            try:
+                catchup = hub.activate(sub)
+            except Exception:  # noqa: BLE001 — a hub bug must not kill the loop
+                log.exception("stream activate failed")
+                catchup = b""
+            if catchup:
+                conn.wbufs.append(memoryview(catchup))
+        conn.last_write_progress = time.monotonic()
+        self._try_write(conn)
+
+    def _stream_write(self, conn: _Conn, payload: bytes) -> None:
+        """Loop-thread: push one frame to a subscriber. A viewer that
+        stopped reading accumulates pending views; past the buffer cap it
+        is shed immediately (counted) rather than waiting out the write
+        deadline — its memory cost is bounded either way."""
+        if conn.closed or not conn.streaming or conn.close_after:
+            # close_after = the stream is already ending (shed flush in
+            # flight): later frames are dropped, not re-shed/re-counted.
+            return
+        pending = sum(len(m) for m in conn.wbufs)
+        if pending + len(payload) > self.state.stream_max_buffer_bytes:
+            hub = self.state.stream
+            if hub is not None:
+                hub.count_slow_shed()
+            log.debug("stream subscriber %s shed: %d pending bytes",
+                      conn.ip, pending)
+            self._close_conn(conn)
+            return
+        conn.wbufs.append(memoryview(payload))
+        self._try_write(conn)
+
+    def _end_stream(self, conn: _Conn) -> None:
+        """Server-initiated stream end (shed, hub close): FLUSH-then-
+        close — the final labeled ``shed`` frame already queued must
+        reach the viewer (the RUNBOOK contract); a viewer too stalled to
+        take it is bounded by the write-progress deadline as ever."""
+        if conn.closed:
+            return
+        if not conn.streaming:
+            self._close_conn(conn)
+            return
+        conn.close_after = True
+        if not conn.wbufs:
+            self._close_conn(conn)
+            return
+        conn.response_pending = True  # drain → _finish_request → close
+        self._try_write(conn)
+
+    def _task_stream(self, conn: _Conn, query: str) -> None:
+        """Worker task for GET /api/v1/stream: validate the query shape,
+        then either register an SSE subscription (snapshot now, deltas
+        pushed per round) or serve/park one long-poll turn."""
+        from tpu_pod_exporter.stream import HubFull, QueryShape
+
+        st = self.state
+        hub = st.stream
+        if hub is None:
+            self.post_response(conn, _json_response(404, {
+                "status": "error",
+                "error": "streaming not enabled on this tier "
+                         "(no stream hub attached; poll /api/v1 instead)",
+            }))
+            return
+        qs = parse_qs(query, keep_blank_values=True)
+
+        def param(name: str) -> str | None:
+            vals = qs.get(name)
+            return vals[-1] if vals else None
+
+        match = {
+            k[len("match["):-1]: vs[-1]
+            for k, vs in qs.items()
+            if k.startswith("match[") and k.endswith("]") and len(k) > 7
+        }
+        try:
+            shape = QueryShape.from_params(param, match)
+        except ValueError as e:
+            self.post_response(conn, _json_response(400, {
+                "status": "error", "error": str(e)}))
+            return
+        transport = param("transport") or "sse"
+        if transport not in ("sse", "longpoll"):
+            self.post_response(conn, _json_response(400, {
+                "status": "error",
+                "error": "transport must be sse or longpoll"}))
+            return
+        if transport == "longpoll":
+            raw = param("cursor")
+            try:
+                cursor = int(raw) if raw is not None else None
+            except ValueError:
+                self.post_response(conn, _json_response(400, {
+                    "status": "error", "error": "cursor must be an integer",
+                }))
+                return
+
+            def answer(doc: dict) -> None:
+                self.post_response(conn, _json_response(200, doc))
+
+            try:
+                doc = hub.poll_frames(shape, cursor, answer)
+            except Exception as e:  # noqa: BLE001 — a broken shape answers, never hangs
+                self.post_response(conn, _json_response(500, {
+                    "status": "error", "error": str(e)}))
+                return
+            if doc is not None:
+                answer(doc)
+            # else: parked — the hub answers from a later round or the
+            # heartbeat tick.
+            return
+        try:
+            sub, first = hub.subscribe(
+                shape,
+                writer=lambda payload: self.call_soon(
+                    lambda: self._stream_write(conn, payload)),
+                closer=lambda: self.call_soon(
+                    lambda: self._end_stream(conn)),
+                auto_start=False,
+            )
+        except HubFull:
+            self.post_raw(conn, _STREAM_REJECT_RESPONSE)
+            return
+        except Exception as e:  # noqa: BLE001 — a broken shape answers, never hangs
+            self.post_response(conn, _json_response(500, {
+                "status": "error", "error": str(e)}))
+            return
+        self.call_soon(lambda: self._begin_stream(conn, sub, first))
+
+    def _arm_stream_tick(self) -> None:
+        """Loop-thread: recurring 1 s maintenance tick for the stream hub
+        (heartbeats, long-poll timeouts, idle-shape GC)."""
+        hub = self.state.stream
+        if hub is None or self._stopping:
+            return
+
+        def tick() -> None:
+            if self._stopping:
+                return
+            h = self.state.stream
+            if h is not None:
+                h.tick()
+            self.call_later(1.0, tick)
+
+        self.call_later(1.0, tick)
+
     # ------------------------------------------------------------- routing
 
     def _count_reject(self, cause: str) -> None:
@@ -964,6 +1239,12 @@ class _EventLoopServer:
         st = self.state
         if path == "/metrics":
             self._handle_metrics(conn, req)
+        elif path == "/api/v1/stream":
+            # Outside the 2-permit /api/v1 fence: a subscription is a
+            # long-lived registration, not a query — holding a permit for
+            # the stream's lifetime would wedge the polled API behind two
+            # viewers. The hub's subscriber cap is the admission control.
+            self._defer(conn, lambda: self._task_stream(conn, query))
         elif path.startswith("/api/v1/"):
             self._defer(conn, lambda: self._task_api(conn, req, path, query))
         elif path.startswith("/debug/") and not debug_client_allowed(
@@ -1478,6 +1759,9 @@ class MetricsServer:
         max_open_connections: int = 0,
         max_requests_per_client: int = 0,
         max_workers: int = 8,
+        worker_idle_expire_s: float = 10.0,
+        stream_hub: Any = None,
+        stream_max_buffer_bytes: int = 2 << 20,
     ) -> None:
         # Every cause pre-seeded so the self-metric publishes a 0 series
         # per cause from poll 1 (stable surface). "connections"/"client"
@@ -1527,8 +1811,11 @@ class MetricsServer:
         state.max_open_connections = max_open_connections
         state.conn_stats = self.conn_stats
         state.max_requests_per_client = max_requests_per_client
+        state.stream = stream_hub
+        state.stream_max_buffer_bytes = stream_max_buffer_bytes
         self._state = state
-        self._loop = _EventLoopServer(host, port, state, max_workers)
+        self._loop = _EventLoopServer(host, port, state, max_workers,
+                                      worker_idle_expire_s)
         self._httpd = _CompatHandle(state)
         self._thread: threading.Thread | None = None
 
@@ -1549,6 +1836,10 @@ class MetricsServer:
             "worker_dispatched": loop.served["worker"],
             "worker_threads": loop.pool.threads,
             "worker_queue": loop.pool.queued,
+            "stream_subscribers": (
+                self._state.stream.subscribers
+                if self._state.stream is not None else 0
+            ),
         }
 
     def start(self) -> None:
@@ -1558,6 +1849,10 @@ class MetricsServer:
             target=self._loop.run, name="tpu-exporter-http", daemon=True,
         )
         self._thread.start()
+        if self._state.stream is not None:
+            # Heartbeats / long-poll timeouts / shape GC ride a loop
+            # timer; call_soon is the thread-safe way onto the loop.
+            self._loop.call_soon(self._loop._arm_stream_tick)
 
     def stop(self) -> None:
         loop = self._loop

@@ -72,6 +72,7 @@ from tpu_pod_exporter.aggregate import (
     read_targets_file,
 )
 from tpu_pod_exporter.fleet import (
+    QueryCache,
     data_shape as fleet_data_shape,
     default_api_fetch,
     rows_of as fleet_rows_of,
@@ -755,6 +756,12 @@ class RootAggregator:
             max_workers=min(max(len(self._leaves), 1), 16),
             thread_name_prefix="tpu-root-scrape",
         )
+        # Attachment seams (same contract as SliceAggregator's): emit
+        # hooks ride _publish's SnapshotBuilder (stream-hub/replica
+        # surfaces), round hooks fire at the end of poll_once with the
+        # new round number (poll-side cost must stay trivial).
+        self.emit_hooks: list[Callable[[SnapshotBuilder], None]] = []
+        self.round_hooks: list[Callable[[int], None]] = []
 
     # ------------------------------------------------------------------ round
 
@@ -947,6 +954,12 @@ class RootAggregator:
             except Exception as e:  # noqa: BLE001 — history must not break merging
                 self._rlog.warning("fleet_store",
                                    "fleet store append failed: %s", e)
+        for hook in self.round_hooks:
+            try:
+                hook(self.rounds)
+            except Exception as e:  # noqa: BLE001 — a hook must never fail a round
+                self._rlog.warning("round_hook",
+                                   "round hook failed: %s", e)
 
     def _publish(
         self,
@@ -1056,6 +1069,11 @@ class RootAggregator:
             try:
                 self._fleet_store.emit(b)
             except Exception:  # noqa: BLE001 — store surface must not fail publish
+                pass
+        for emit_hook in self.emit_hooks:
+            try:
+                emit_hook(b)
+            except Exception:  # noqa: BLE001 — hook surface must not fail publish
                 pass
         cpu_s = utils.process_cpu_seconds()
         if cpu_s is not None:
@@ -1196,6 +1214,8 @@ class RootQueryPlane:
         leaf_breakers: Mapping[str, CircuitBreaker] | None = None,
         wallclock: Callable[[], float] = time.time,
         max_workers: int = 16,
+        generation_fn: Callable[[], int] | None = None,
+        cache_entries: int = 128,
     ) -> None:
         if not topology:
             raise ValueError("root query plane needs at least one shard")
@@ -1213,6 +1233,14 @@ class RootQueryPlane:
         self._breakers = leaf_breakers
         self._wallclock = wallclock
         self._rlog = RateLimitedLogger(log)
+        # Generation-keyed result cache, the fleet plane's discipline one
+        # tier up: with a generation_fn (the root's round counter) every
+        # panel — and every stream-hub shape evaluation — costs ONE
+        # two-level fan-out per round, however many viewers ask. Without
+        # one (pre-existing constructions), every query fans out, the
+        # original behavior.
+        self._generation_fn = generation_fn
+        self._cache = QueryCache(cache_entries if generation_fn else 0)
         self._pool = ThreadPoolExecutor(
             max_workers=min(max(len(self._leaves), 1), max_workers),
             thread_name_prefix="tpu-root-query",
@@ -1221,7 +1249,7 @@ class RootQueryPlane:
     # ------------------------------------------------------------- public API
 
     def series(self) -> dict:
-        return self._query("series", "/api/v1/series", {})
+        return self._query("series", "/api/v1/series", {}, key=("series",))
 
     def query_range(
         self,
@@ -1236,11 +1264,24 @@ class RootQueryPlane:
             end = self._wallclock()
         if start is None:
             start = end - 300.0
+        if step > 0:
+            # Grid alignment (fleet.py's): sliding dashboard windows land
+            # on the same cache key within a generation, and grid points
+            # given up at the OLD edge keep the widened range inside the
+            # node-side resolution cap.
+            start = (start // step) * step
+            end = -((-end) // step) * step
+            if (end - start) / step > 11000:
+                start = end - 11000 * step
+        match = dict(match or {})
         params = {"metric": metric, "start": f"{start:.3f}",
                   "end": f"{end:.3f}", "step": f"{step:g}", "agg": agg}
-        for k, v in dict(match or {}).items():
+        for k, v in match.items():
             params[f"match[{k}]"] = v
-        return self._query("query_range", "/api/v1/query_range", params)
+        key = ("query_range", metric, tuple(sorted(match.items())),
+               round(start, 3), round(end, 3), step, agg)
+        return self._query("query_range", "/api/v1/query_range", params,
+                           key=key)
 
     def window_stats(
         self,
@@ -1248,10 +1289,14 @@ class RootQueryPlane:
         match: Mapping[str, str] | None = None,
         window_s: float = 60.0,
     ) -> dict:
+        match = dict(match or {})
         params = {"metric": metric, "window": f"{window_s:g}"}
-        for k, v in dict(match or {}).items():
+        for k, v in match.items():
             params[f"match[{k}]"] = v
-        return self._query("window_stats", "/api/v1/window_stats", params)
+        key = ("window_stats", metric, tuple(sorted(match.items())),
+               window_s)
+        return self._query("window_stats", "/api/v1/window_stats", params,
+                           key=key)
 
     # --------------------------------------------------------------- internals
 
@@ -1283,7 +1328,21 @@ class RootQueryPlane:
     _data_shape = staticmethod(fleet_data_shape)
 
     def _query(self, route: str, path: str,
-               params: Mapping[str, str]) -> dict:
+               params: Mapping[str, str], key: tuple = ()) -> dict:
+        generation = (self._generation_fn()
+                      if self._generation_fn is not None else 0)
+        cache_key = key + (generation,)
+        cached = self._cache.get(cache_key)
+        if cached is not None:
+            # Shared + read-only, same contract as fleet.py's cache;
+            # only the top-level marker differs per response.
+            return {**cached, "cached": True}
+        env = self._query_uncached(route, path, params, generation)
+        self._cache.put(cache_key, env)
+        return env
+
+    def _query_uncached(self, route: str, path: str,
+                        params: Mapping[str, str], generation: int) -> dict:
         t0 = time.monotonic()
         leaf_states: dict[str, dict] = {}
         futures = {}
@@ -1429,11 +1488,158 @@ class RootQueryPlane:
                 "merged_series": len(merged),
                 "duplicate_series": duplicates,
             },
+            "generation": generation,
             "took_s": round(took, 6),
         }
 
+    # ------------------------------------------------- pressure shed hooks
+
+    def cache_bytes(self) -> int:
+        """Result-cache byte estimate for the memory ladder's component
+        accounting (same number /debug/vars would report)."""
+        return self._cache.bytes()
+
+    def set_cache_enabled(self, enabled: bool) -> None:
+        """fleet_cache memory rung, root flavor: clear + disable (every
+        query re-fans-out; correctness unchanged). Reversible."""
+        self._cache.set_enabled(enabled)
+
     def close(self) -> None:
         self._pool.shutdown(wait=False)
+
+
+# ---------------------------------------------------------------- replicas
+
+
+class ReplicaSourceProxy:
+    """The /api/v1 front of a stateless read replica.
+
+    Live queries serve from the replica's own two-level fan-out
+    (``RootQueryPlane``) — identical to the root's answers by the
+    freshest-wins dedup contract. ``?source=`` queries need the fleet
+    store, which exactly one root owns: with ``--root-url`` configured
+    they are proxied there verbatim (tagged ``proxied: true``, counted in
+    ``tpu_replica_store_proxied_total``); without it they 400 with an
+    actionable message — a replica silently answering ``source=store``
+    from live data would let an operator trust history that is not there
+    (the store.StoreQueryPlane honesty rule, one tier over).
+    """
+
+    # The server threads ?source= through to planes that declare it.
+    handles_source = True
+
+    def __init__(
+        self,
+        inner: RootQueryPlane,
+        replica_id: str = "replica",
+        root_url: str = "",
+        fetch: Callable[..., dict] = default_api_fetch,
+        timeout_s: float = 5.0,
+    ) -> None:
+        self._inner = inner
+        self.replica_id = replica_id
+        self._root_url = root_url.strip().rstrip("/")
+        self._fetch = fetch
+        self._timeout_s = timeout_s
+        self._counters = CounterStore()
+        for result in ("ok", "error"):
+            self._counters.inc(
+                schema.TPU_REPLICA_STORE_PROXIED_TOTAL.name, (result,), 0.0)
+
+    # ------------------------------------------------------------- queries
+
+    def series(self, source: str = "") -> dict:
+        if source:
+            return self._proxy("/api/v1/series", {"source": source})
+        return self._inner.series()
+
+    def query_range(
+        self,
+        metric: str,
+        match: Mapping[str, str] | None = None,
+        start: float | None = None,
+        end: float | None = None,
+        step: float = 0.0,
+        agg: str = "last",
+        source: str = "",
+    ) -> dict:
+        if source:
+            if end is None:
+                end = time.time()
+            if start is None:
+                start = end - 300.0
+            params = {"metric": metric, "start": f"{start:.3f}",
+                      "end": f"{end:.3f}", "step": f"{step:g}",
+                      "agg": agg, "source": source}
+            for k, v in dict(match or {}).items():
+                params[f"match[{k}]"] = v
+            return self._proxy("/api/v1/query_range", params)
+        return self._inner.query_range(metric, match, start, end, step,
+                                       agg=agg)
+
+    def window_stats(
+        self,
+        metric: str,
+        match: Mapping[str, str] | None = None,
+        window_s: float = 60.0,
+        source: str = "",
+    ) -> dict:
+        if source:
+            params = {"metric": metric, "window": f"{window_s:g}",
+                      "source": source}
+            for k, v in dict(match or {}).items():
+                params[f"match[{k}]"] = v
+            return self._proxy("/api/v1/window_stats", params)
+        return self._inner.window_stats(metric, match, window_s=window_s)
+
+    def _proxy(self, path: str, params: Mapping[str, str]) -> dict:
+        if not self._root_url:
+            # Mapped to the same 400 contract as every other param error.
+            raise ValueError(
+                "source= requires the root's fleet store; this replica "
+                "owns no store and has no --root-url to proxy to — query "
+                "the root directly or start the replica with --root-url"
+            )
+        url = target_query_url(self._root_url, path, params)
+        try:
+            doc = self._fetch(url, self._timeout_s)
+        except urllib.error.HTTPError as e:
+            # The root ANSWERED (e.g. its own 400 for a store-less
+            # ?source=): relay the refusal as a refusal, not an outage.
+            self._counters.inc(
+                schema.TPU_REPLICA_STORE_PROXIED_TOTAL.name, ("error",))
+            raise ValueError(
+                f"root store proxy refused: HTTP {e.code}") from e
+        except Exception as e:  # noqa: BLE001 — a dead root degrades, never kills
+            self._counters.inc(
+                schema.TPU_REPLICA_STORE_PROXIED_TOTAL.name, ("error",))
+            return {
+                "status": "error", "proxied": True,
+                "error": f"root store proxy failed: {e}",
+                "root_url": self._root_url,
+            }
+        self._counters.inc(
+            schema.TPU_REPLICA_STORE_PROXIED_TOTAL.name, ("ok",))
+        if isinstance(doc, dict):
+            return {**doc, "proxied": True}
+        return {"status": "error", "proxied": True,
+                "error": "root store proxy returned a non-object"}
+
+    # ----------------------------------------------------------- exposition
+
+    def emit(self, b: SnapshotBuilder) -> None:
+        """Replica identity + proxy accounting (rides the replica's
+        publish via its emit hook). tpu_replica_info doubles as the
+        'am I talking to a replica?' probe for clients and drills."""
+        for spec in schema.REPLICA_SPECS:
+            b.declare(spec)
+        b.add(schema.TPU_REPLICA_INFO, 1.0, (self.replica_id,))
+        for lv, v in self._counters.items_for(
+                schema.TPU_REPLICA_STORE_PROXIED_TOTAL.name):
+            b.add(schema.TPU_REPLICA_STORE_PROXIED_TOTAL, v, lv)
+
+    def close(self) -> None:
+        self._inner.close()
 
 
 # ------------------------------------------------------------------------ CLI
@@ -1467,6 +1673,34 @@ def _add_common_flags(p: argparse.ArgumentParser) -> None:
     p.add_argument("--log-level", default="info")
     p.add_argument("--log-format", default="text", choices=("text", "json"),
                    help="json = one Cloud-Logging-shaped object per line")
+    # Streaming dashboard plane (tpu_pod_exporter.stream): every
+    # aggregation tier can serve /api/v1/stream — viewers register a
+    # query once and receive per-round deltas instead of polling.
+    p.add_argument("--stream", default="on", choices=("on", "off"),
+                   help="/api/v1/stream subscriptions (SSE + long-poll "
+                        "fallback): per-round deltas of a registered "
+                        "query, one delta computation per query shape "
+                        "per round however many viewers share it")
+    p.add_argument("--stream-max-subscribers", type=int, default=10000,
+                   help="admission cap on live stream subscriptions; "
+                        "past it new subscribers get 429 and should "
+                        "retry against a read replica")
+    p.add_argument("--stream-heartbeat-s", type=float, default=10.0,
+                   help="heartbeat frames to quiet subscribers (keeps "
+                        "NAT/proxy paths alive between rounds); 0 "
+                        "disables")
+    p.add_argument("--stream-full-sync-s", type=float, default=60.0,
+                   help="periodic full-answer frames on every stream "
+                        "(delta-only streams rot — the egress full-sync "
+                        "lesson); 0 disables")
+    p.add_argument("--memory-budget-mb", type=float, default=0.0,
+                   help="memory budget over the serving-tier components "
+                        "(query result cache, stream hub retained "
+                        "answers), enforced by the pressure governor: "
+                        "past it the ladder sheds the result cache "
+                        "first, then the OLDEST stream subscriptions "
+                        "(stream_shed rung, counted + labeled; viewers "
+                        "reconnect against a replica). 0 = no budget")
 
 
 def main(argv: list[str] | None = None) -> int:
@@ -1475,7 +1709,8 @@ def main(argv: list[str] | None = None) -> int:
         description="Sharded HA aggregation tree: consistent-hash leaf "
                     "aggregators plus a freshest-wins root merge tier.",
     )
-    p.add_argument("--role", required=True, choices=("leaf", "root"))
+    p.add_argument("--role", required=True,
+                   choices=("leaf", "root", "replica"))
     _add_common_flags(p)
     # Leaf-only:
     p.add_argument("--shard-index", type=int, default=0,
@@ -1533,10 +1768,20 @@ def main(argv: list[str] | None = None) -> int:
                         "first, counted as reason=\"shed\"; coarse tiers "
                         "— the days-long window — shed last). 0 = no "
                         "budget (retention trim alone bounds disk)")
+    # Replica-only:
+    p.add_argument("--replica-id", default="",
+                   help="[replica] identity published as tpu_replica_info"
+                        "{replica=...}; default replica-<pid>")
+    p.add_argument("--root-url", default="",
+                   help="[replica] the real root's base URL: ?source= "
+                        "store queries are proxied there (replicas own "
+                        "no store); empty = such queries 400 honestly")
     ns = p.parse_args(argv)
     utils.setup_logging(ns.log_level, ns.log_format)
     if ns.role == "leaf":
         return _run_leaf(ns, p)
+    if ns.role == "replica":
+        return _run_replica(ns, p)
     return _run_root(ns, p)
 
 
@@ -1561,6 +1806,22 @@ def _serve_until_signal(loop: Any, server: Any,
         except Exception:  # noqa: BLE001 — draining must finish
             pass
     return 0
+
+
+def _attach_stream_cli(ns: argparse.Namespace, agg: Any,
+                       plane: Any) -> tuple[Any, Any]:
+    """Stream-hub wiring shared by every role: (hub, pump), or (None,
+    None) with --stream off or no query plane to answer through."""
+    if ns.stream != "on" or plane is None:
+        return None, None
+    from tpu_pod_exporter.stream import attach_stream
+
+    return attach_stream(
+        agg, plane,
+        heartbeat_s=ns.stream_heartbeat_s,
+        full_sync_s=ns.stream_full_sync_s,
+        max_subscribers=ns.stream_max_subscribers,
+    )
 
 
 def _run_leaf(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
@@ -1612,18 +1873,22 @@ def _run_leaf(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         targets_fn=lambda: agg.targets,
     )
     agg.set_fleet(fleet)
+    hub, pump = _attach_stream_cli(ns, agg, fleet)
     loop = CollectorLoop(agg, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         debug_vars=agg.debug_vars, debug_addr=ns.debug_addr, fleet=fleet,
         ready_detail_fn=agg.ready_detail,
+        stream_hub=hub,
     )
     agg.poll_once()  # synchronous first round so /readyz flips immediately
     log.info("leaf %s (%s) aggregating %d/%s targets on :%d every %.1fs",
              leaf_id, shard_id, len(agg.targets),
              ns.targets_file or "static", server.port, ns.interval_s)
-    return _serve_until_signal(loop, server, [fleet, agg])
+    return _serve_until_signal(
+        loop, server,
+        [c for c in (pump, hub, fleet, agg) if c is not None])
 
 
 def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
@@ -1714,27 +1979,138 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         render_splice=ns.render_splice == "on",
     )
     plane: Any = None
+    inner_plane: Any = None
     if ns.fleet_query == "on":
-        plane = RootQueryPlane(topology, timeout_s=ns.timeout_s + 0.5,
-                               leaf_breakers=root._breakers)
+        plane = inner_plane = RootQueryPlane(
+            topology, timeout_s=ns.timeout_s + 0.5,
+            leaf_breakers=root._breakers,
+            generation_fn=lambda: root.rounds)
     if fleet_store is not None:
         from tpu_pod_exporter.store import StoreQueryPlane
 
         # Source-aware front: live fan-out + store fills (store-only when
         # --fleet-query off). Serves through the same server hook.
         plane = StoreQueryPlane(plane, fleet_store)
+    hub, pump = _attach_stream_cli(ns, root, plane)
+    if ns.memory_budget_mb > 0:
+        from tpu_pod_exporter.pressure import build_serving_governor
+
+        # Serving-tier memory ladder: result cache sheds first, oldest
+        # stream subscriptions last. Extends the store governor when one
+        # exists (one governor per process), else builds + starts one.
+        governor = build_serving_governor(
+            int(ns.memory_budget_mb * (1 << 20)),
+            sidecar_dir=ns.state_dir or ns.store_dir,
+            cache_plane=inner_plane, hub=hub, governor=governor,
+        )
     loop = CollectorLoop(root, interval_s=ns.interval_s)
     server = MetricsServer(
         store, host=ns.host, port=ns.port,
         health_max_age_s=max(10.0 * ns.interval_s, 10.0),
         debug_vars=root.debug_vars, debug_addr=ns.debug_addr, fleet=plane,
         ready_detail_fn=root.ready_detail,
+        stream_hub=hub,
     )
     root.poll_once()
     log.info("root merging %d shard(s) / %d leaf(s) on :%d every %.1fs",
              len(topology), sum(len(v) for v in topology.values()),
              server.port, ns.interval_s)
-    closers = [c for c in (plane, governor, root) if c is not None]
+    closers = [c for c in (pump, hub, plane, governor, root)
+               if c is not None]
+    return _serve_until_signal(loop, server, closers)
+
+
+def _run_replica(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
+    """Stateless root read replica: scrape the leaves read-only exactly
+    like the root (same merge, same freshest-wins dedup — replica reads
+    are consistent by construction), serve /metrics + /api/v1 + the
+    stream endpoint, own NOTHING durable: no egress, no persistence, no
+    store writes. Viewer fan-out scales by adding replicas while exactly
+    one root keeps the write-side duties."""
+    from tpu_pod_exporter.collector import CollectorLoop
+    from tpu_pod_exporter.server import MetricsServer
+
+    if not ns.leaves:
+        p.error("replica role needs --leaves (same topology as the root)")
+    if ns.state_dir:
+        p.error("replicas are stateless by design: --state-dir would "
+                "persist breaker/shard state a replica must not own — "
+                "drop the flag (the root keeps the durable state)")
+    if ns.store_dir or ns.store_max_disk_mb > 0 or ns.store_tiers \
+            or ns.store_rules:
+        p.error("replicas own no fleet store: use --root-url to proxy "
+                "?source= queries to the root's store instead of "
+                "--store-* flags")
+    topology = parse_leaf_topology(ns.leaves)
+    ring_n = max(ns.num_shards, 1)
+    if ring_n < len(topology):
+        if ns.num_shards > 1:
+            p.error(f"--leaves lists {len(topology)} shards but "
+                    f"--num-shards is {ns.num_shards}")
+        ring_n = len(topology)
+    shard_map = ShardMap(default_shards(ring_n))
+    unknown = sorted(set(topology) - set(shard_map.shards))
+    if unknown:
+        p.error(f"--leaves names shard(s) {unknown} outside the "
+                f"{ring_n}-shard ring (shard-0..shard-{ring_n - 1}); "
+                f"check --num-shards")
+    store = SnapshotStore()
+    replica_id = ns.replica_id or f"replica-{os.getpid()}"
+    root = RootAggregator(
+        topology, store, timeout_s=ns.timeout_s,
+        loop_overruns_fn=lambda: loop.overruns,
+        targets_file=ns.targets_file,
+        shard_map=shard_map,
+        stale_serve_s=ns.stale_serve_s,
+        render_splice=ns.render_splice == "on",
+    )
+    plane: Any = None
+    inner_plane: Any = None
+    if ns.fleet_query == "on":
+        inner_plane = RootQueryPlane(
+            topology, timeout_s=ns.timeout_s + 0.5,
+            leaf_breakers=root._breakers,
+            generation_fn=lambda: root.rounds)
+        plane = ReplicaSourceProxy(
+            inner_plane,
+            replica_id=replica_id,
+            root_url=ns.root_url,
+        )
+        root.emit_hooks.append(plane.emit)
+    else:
+        # Identity must publish even without a query plane — clients and
+        # drills probe tpu_replica_info to tell a replica from the root.
+        def _emit_identity(b: SnapshotBuilder) -> None:
+            for spec in schema.REPLICA_SPECS:
+                b.declare(spec)
+            b.add(schema.TPU_REPLICA_INFO, 1.0, (replica_id,))
+
+        root.emit_hooks.append(_emit_identity)
+    hub, pump = _attach_stream_cli(ns, root, plane)
+    governor: Any = None
+    if ns.memory_budget_mb > 0:
+        from tpu_pod_exporter.pressure import build_serving_governor
+
+        governor = build_serving_governor(
+            int(ns.memory_budget_mb * (1 << 20)),
+            cache_plane=inner_plane, hub=hub,
+        )
+    loop = CollectorLoop(root, interval_s=ns.interval_s)
+    server = MetricsServer(
+        store, host=ns.host, port=ns.port,
+        health_max_age_s=max(10.0 * ns.interval_s, 10.0),
+        debug_vars=root.debug_vars, debug_addr=ns.debug_addr, fleet=plane,
+        ready_detail_fn=root.ready_detail,
+        stream_hub=hub,
+    )
+    root.poll_once()
+    log.info("replica %s merging %d shard(s) / %d leaf(s) READ-ONLY on "
+             ":%d every %.1fs (store proxy: %s)",
+             replica_id, len(topology),
+             sum(len(v) for v in topology.values()),
+             server.port, ns.interval_s, ns.root_url or "off")
+    closers = [c for c in (pump, hub, plane, governor, root)
+               if c is not None]
     return _serve_until_signal(loop, server, closers)
 
 

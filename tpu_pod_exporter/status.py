@@ -78,6 +78,15 @@ def main(argv=None) -> int:
         try:
             if ns.watch <= 0:
                 return _run_fleet(ns.fleet, ns.fleet_window, as_json=ns.json)
+            # Ride a stream subscription when the aggregator offers one:
+            # the server pushes per-round deltas, so the watch stops
+            # paying a full fleet fan-out per frame. None = no stream on
+            # this tier (or it went away) — fall back to polling.
+            rc = _watch_fleet_stream(
+                ns.fleet, ns.fleet_window, ns.watch,
+                as_json="line" if ns.json else False)
+            if rc is not None:
+                return rc
             while True:
                 if not ns.json:
                     print("\x1b[H\x1b[2J", end="")
@@ -497,6 +506,25 @@ def _watch_tree(addr: str, interval_s: float, as_json=False,
     store shows up mid-watch."""
     import json as _json
 
+    # Stream-ticked refresh when the root offers /api/v1/stream: renders
+    # then track ROUNDS (a delta frame = the root published) instead of a
+    # blind interval — no wasted refreshes between rounds, sub-interval
+    # reaction when rounds are fast. A missing/never/dead stream falls
+    # back to the plain interval sleep below.
+    ticker = None
+    hp = _split_addr(addr)
+    if hp is not None:
+        try:
+            from tpu_pod_exporter.stream import QueryShape, StreamClient
+
+            ticker = StreamClient(
+                hp[0], hp[1],
+                QueryShape(route="window_stats",
+                           metric="tpu_hbm_used_bytes",
+                           window_s=max(interval_s * 4, 30.0)),
+                timeout_s=5.0)
+        except Exception:  # noqa: BLE001 — no stream = plain polling
+            ticker = None
     last_doc: dict | None = None
     last_ok = time.monotonic()
     while True:
@@ -528,7 +556,17 @@ def _watch_tree(addr: str, interval_s: float, as_json=False,
                 error=error,
                 unreachable_s=time.monotonic() - last_ok,
             ))
-        time.sleep(interval_s)
+        if ticker is not None and not ticker.eof:
+            # Block until the next round's frame (or heartbeat/timeout —
+            # either way, re-render no later than a slow poll would).
+            for _frame in ticker.frames(max_frames=1,
+                                        timeout_s=max(interval_s * 3, 5.0)):
+                break
+        else:
+            if ticker is not None:
+                ticker.close()
+                ticker = None
+            time.sleep(interval_s)
 
 
 def _run_tree(addr: str, as_json=False, store_dir: str = "") -> int:
@@ -547,6 +585,97 @@ def _run_tree(addr: str, as_json=False, store_dir: str = "") -> int:
     print()
     print(render_tree(doc))
     return 0
+
+
+def _split_addr(addr: str) -> tuple[str, int] | None:
+    a = addr
+    if a.startswith(("http://", "https://")):
+        a = a.split("//", 1)[1]
+    a = a.split("/", 1)[0]
+    host, _, port_s = a.partition(":")
+    try:
+        return host or "127.0.0.1", int(port_s or "80")
+    except ValueError:
+        return None
+
+
+def _watch_fleet_stream(addr: str, window_s: float, interval_s: float,
+                        as_json=False) -> int | None:
+    """``--fleet --watch`` over /api/v1/stream: one subscription per
+    fleet metric, each frame a per-round delta applied to a local replay
+    — the aggregator evaluates each shape once per round however many
+    watchers ride it, and this tool stops paying a fan-out per frame.
+    Returns None when the server offers no stream endpoint (or the
+    stream dies mid-watch): the caller falls back to polling."""
+    import json as _json
+
+    from tpu_pod_exporter.stream import (
+        DATA_FRAME_TYPES,
+        QueryShape,
+        StreamClient,
+        StreamDisabled,
+        StreamReplay,
+    )
+
+    hp = _split_addr(addr)
+    if hp is None:
+        return None
+    host, port = hp
+    subs: list[tuple[str, StreamClient, StreamReplay]] = []
+    try:
+        for metric in _FLEET_METRICS:
+            shape = QueryShape(route="window_stats", metric=metric,
+                               window_s=window_s)
+            subs.append((metric, StreamClient(host, port, shape,
+                                              timeout_s=5.0),
+                         StreamReplay()))
+    except StreamDisabled:
+        for _m, c, _r in subs:
+            c.close()
+        return None
+    except OSError as e:
+        for _m, c, _r in subs:
+            c.close()
+        print(f"fleet stream against {addr} failed: {e}", file=sys.stderr)
+        return 1
+    try:
+        while True:
+            for _metric, client, replay in subs:
+                for frame in client.frames(timeout_s=0.2):
+                    if frame.get("type") in DATA_FRAME_TYPES \
+                            or frame.get("type") == "shed":
+                        replay.apply(frame)
+            if any(client.eof for _m, client, _r in subs):
+                # Shed / server restart: the polling fallback takes over
+                # (and will retry the subscription on the next watch).
+                return None
+            envelopes = {}
+            for metric, _client, replay in subs:
+                envelopes[metric] = {
+                    "data": {"result": [replay.rows[k]
+                                        for k in sorted(replay.rows)]},
+                    "partial": bool(replay.meta.get("partial")),
+                    "fleet": replay.meta.get("fleet") or {},
+                    # Per-target states ride snapshot/full_sync meta (at
+                    # most --stream-full-sync-s stale) — the degraded-
+                    # target footer must not vanish in stream mode.
+                    "targets": replay.meta.get("targets") or {},
+                    "source": "stream",
+                }
+            if as_json:
+                print(_json.dumps(
+                    {"aggregator": addr, "window_s": window_s,
+                     "transport": "stream", "envelopes": envelopes},
+                    indent=None), flush=True)
+            else:
+                print("\x1b[H\x1b[2J", end="")
+                print(f"fleet view via {addr} (stream)")
+                print()
+                print(render_fleet(envelopes, window_s))
+            time.sleep(interval_s)
+    finally:
+        for _m, c, _r in subs:
+            c.close()
 
 
 def _run_fleet(addr: str, window_s: float, as_json=False) -> int:
