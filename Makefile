@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo dashboard-demo clean
+.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo dashboard-demo alert-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -195,6 +195,26 @@ dashboard-demo:
 		--targets 24 --shards 2 --chips 2 --subs 48 --rounds 4 \
 		--replicas 1 --state-root dashboard-demo-state/negative \
 		--negative
+
+# Native alerting acceptance (deploy/RUNBOOK.md "Alerting without
+# Prometheus"): the alert_partition drill — an asymmetric root-leaf cut
+# where EXACTLY TpuRootLeafPartitioned must fire (TpuRootLeafDown held
+# down by the stale-serve suspicion suppression, nothing else firing), a
+# receiver outage covering the partition onset so the webhook notifier
+# wedges (breaker open, WAL backlog) and drains after heal with a
+# contiguous exactly-once ledger, firing states queryable from the fleet
+# store as ALERTS series and streamed over the alerts route. The second
+# run is the NEGATIVE CONTROL: suppression deliberately broken
+# (--alert-suppression off), TpuRootLeafDown fires too, and the
+# fired-set assertion must make the drill FAIL (non-zero exit asserted).
+alert-demo:
+	python -m tpu_pod_exporter.loadgen.scenario \
+		--scenarios alert_partition --targets 48 --shards 2 \
+		--state-root alert-demo-state
+	! python -m tpu_pod_exporter.loadgen.scenario \
+		--scenarios alert_partition --targets 24 --shards 2 \
+		--alert-suppression off --log-level error \
+		--state-root alert-demo-state/negative
 
 # Resource-pressure governor acceptance (deploy/RUNBOOK.md "Resource
 # pressure playbook"): three drills against real components —

@@ -144,7 +144,9 @@ class OwnershipRule:
 # is named); "pool:*" roles come from ThreadPoolExecutor submit sites.
 OWNERSHIP: tuple[OwnershipRule, ...] = (
     # WalBuffer has exactly ONE cursor-mover per instance. The egress
-    # buffer's mover is the sender thread; the fleet store's tier buffers
+    # buffer's mover is the sender thread; the alert notification
+    # buffer's mover is the alert sender thread (alerting.AlertNotifier,
+    # same seat one subsystem over); the fleet store's tier buffers
     # are moved by the root round (appender) thread — which is the poll
     # thread driving SliceAggregator.poll_once. A governor-thread move
     # racing the appender was PR 11's bug class; the governor may only
@@ -152,25 +154,26 @@ OWNERSHIP: tuple[OwnershipRule, ...] = (
     # on at its next pass.
     OwnershipRule(
         "persist.WalBuffer._advance",
-        ("tpu-egress-sender", "tpu-exporter-poll"),
+        ("tpu-egress-sender", "tpu-alert-sender", "tpu-exporter-poll"),
         "single cursor-mover per buffer: the egress sender owns the "
-        "egress buffer cursor, the root round thread owns the store tier "
-        "cursors; a governor/HTTP-thread advance racing the owner could "
-        "regress the on-disk cursor and resurrect shed records at boot",
+        "egress buffer cursor, the alert sender the alert notification "
+        "cursor, the root round thread the store tier cursors; a "
+        "governor/HTTP-thread advance racing the owner could regress "
+        "the on-disk cursor and resurrect shed records at boot",
     ),
     OwnershipRule(
         "persist.WalBuffer.trim_to_bytes",
-        ("tpu-egress-sender", "tpu-exporter-poll"),
+        ("tpu-egress-sender", "tpu-alert-sender", "tpu-exporter-poll"),
         "cap trims are cursor moves (see WalBuffer._advance)",
     ),
     OwnershipRule(
         "persist.WalBuffer.ack",
-        ("tpu-egress-sender", "tpu-exporter-poll"),
+        ("tpu-egress-sender", "tpu-alert-sender", "tpu-exporter-poll"),
         "acks are cursor moves (see WalBuffer._advance)",
     ),
     OwnershipRule(
         "persist.WalBuffer.drop_oldest",
-        ("tpu-egress-sender", "tpu-exporter-poll"),
+        ("tpu-egress-sender", "tpu-alert-sender", "tpu-exporter-poll"),
         "age/byte-cap drops are cursor moves (see WalBuffer._advance)",
     ),
     OwnershipRule(

@@ -43,7 +43,9 @@ import argparse
 import json
 import os
 import sys
+import threading
 import time
+import urllib.error
 import urllib.request
 
 from tpu_pod_exporter import utils as _utils
@@ -75,6 +77,25 @@ from tpu_pod_exporter.scenario import (
 # rounds, so anything beyond this means a tier silently stopped merging.
 FRESH_STALENESS_BUDGET_S = 8.0
 
+# The alert drills' rule set (tpu_pod_exporter.alerting grammar). Both
+# rules fire IMMEDIATELY (no `for` clause): engine rounds are subsecond
+# and wall-time pendings would make the fired-set assertion timing-
+# dependent. Determinism instead comes from the stack itself — partition
+# suspicion latches in the SAME merge round a leaf drops with a
+# reachable twin (shard.py stale-serve), so under suppression
+# TpuRootLeafDown is held down from the first cut round and only the
+# partition alert ever fires.
+ALERT_DRILL_RULES = """\
+alert TpuRootLeafPartitioned = tpu_root_leaf_partition_suspected == 1
+    labels(severity="page", drill="scenario")
+    annotations(summary="leaf {{ $labels.leaf }} one-sided-unreachable (twin vouches for the pods)")
+
+alert TpuRootLeafDown = tpu_root_leaf_up == 0
+    suppress(tpu_root_leaf_partition_suspected == 1)
+    labels(severity="page", drill="scenario")
+    annotations(summary="leaf {{ $labels.leaf }} unreachable and nothing vouches for it")
+"""
+
 
 def _get_json(url: str, timeout_s: float = 5.0) -> dict:
     with urllib.request.urlopen(url, timeout=timeout_s) as resp:  # noqa: S310 — loopback harness
@@ -102,7 +123,8 @@ class _Run:
                  chips: int, state_root: str, seed: int,
                  stale_serve_s: float = 30.0,
                  governor: bool = True, store: bool = True,
-                 stream: bool = True) -> None:
+                 stream: bool = True,
+                 alert_suppression: bool = True) -> None:
         from tpu_pod_exporter.egress import (
             RemoteWriteShipper,
             aggregator_egress_metrics,
@@ -218,6 +240,56 @@ class _Run:
             )
             self.shipper.load()
             self.shipper.start()
+        # Native alerting plane (alert drills): an in-root AlertEvaluator
+        # over the drill rule set, its webhook notifier backed by the
+        # same WAL + breaker discipline as egress. The send callable IS
+        # the ledger oracle (contiguous seqs = exactly-once), and a
+        # recv_outage event wedges it alongside the remote-write
+        # receiver so the backlog/drain path is exercised by a fault the
+        # engine already injects. suppression=False is the fired-set
+        # assertion's NEGATIVE CONTROL (--alert-suppression off).
+        self.alert_eval = None
+        self.alert_notifier = None
+        self.alert_suppression = alert_suppression
+        self._alert_outage = False
+        self._alert_ledger_lock = threading.Lock()
+        self.alert_ledger: list[int] = []
+        self.alert_notes: list[dict] = []
+        if scn.expected_alerts is not None:
+            from tpu_pod_exporter.alerting import (
+                SEQ_HEADER,
+                AlertEvaluator,
+                AlertNotifier,
+                parse_alert_rules,
+            )
+
+            alert_dir = os.path.join(state_root, "alerts")
+
+            def _alert_send(url: str, body: bytes, headers: dict,
+                            timeout_s: float) -> int:
+                if self._alert_outage:
+                    raise urllib.error.URLError(
+                        "drill: alert receiver outage")
+                seq = int(headers.get(SEQ_HEADER, "0") or 0)
+                with self._alert_ledger_lock:
+                    self.alert_ledger.append(seq)
+                    self.alert_notes.append(json.loads(body))
+                return 200
+
+            self.alert_notifier = AlertNotifier(
+                "http://alert-recv.invalid/hook", alert_dir,
+                breaker=build_breaker(2, 0.1, 0.8),
+                send=_alert_send,
+            )
+            self.alert_notifier.load()
+            self.alert_notifier.start()
+            self.alert_eval = AlertEvaluator(
+                parse_alert_rules(ALERT_DRILL_RULES),
+                alert_dir=alert_dir,
+                notifier=self.alert_notifier,
+                store=self.store,
+                suppression=alert_suppression,
+            )
         # Resource-pressure governor over the root-side stack: the disk
         # ladder watches the egress dir (segment compaction rung), the
         # memory ladder the byte-accounted caches (leaf fleet caches
@@ -388,7 +460,11 @@ class _Run:
         streams must follow the fresh instance)."""
         from tpu_pod_exporter.stream import plane_poll_fn
 
-        return plane_poll_fn(self.plane)(shape, generation)
+        ev = getattr(self, "alert_eval", None)
+        return plane_poll_fn(
+            self.plane,
+            alerts_fn=ev.rows if ev is not None else None,
+        )(shape, generation)
 
     def _dash_shapes(self):
         from tpu_pod_exporter.stream import QueryShape
@@ -509,8 +585,13 @@ class _Run:
                 ev.at_round + j: tuple(live[j * ev.stagger:(j + 1) * ev.stagger])
                 for j in range(ev.duration)
             }
-        elif ev.kind == "recv_outage" and self.receiver is not None:
-            self.receiver.set_outage(True)
+        elif ev.kind == "recv_outage":
+            if self.receiver is not None:
+                self.receiver.set_outage(True)
+            # The alert webhook lives on the receiver tier too: its
+            # notifier must wedge (breaker open, WAL backlog) alongside
+            # the remote-write shipper and drain exactly-once after heal.
+            self._alert_outage = True
         elif ev.kind == "disk_full":
             # Squeeze the disk budget to half the CURRENT usage: a breach
             # is guaranteed whatever the absolute batch sizes are, and the
@@ -588,8 +669,10 @@ class _Run:
             self.recovering |= {farm.url(i) for i in last}
         elif ev.kind == "hotspot":
             farm.hot = set()
-        elif ev.kind == "recv_outage" and self.receiver is not None:
-            self.receiver.set_outage(False)
+        elif ev.kind == "recv_outage":
+            if self.receiver is not None:
+                self.receiver.set_outage(False)
+            self._alert_outage = False
         elif ev.kind == "disk_full":
             # The operator freed space / raised the budget: pressure off,
             # and the settle loop must see the ladder recover to 0.
@@ -681,6 +764,11 @@ class _Run:
                 self.sim.run_round()
                 if self.shipper is not None:
                     self.shipper.on_snapshot(self.sim.root_store.current())
+                if self.alert_eval is not None:
+                    # Ride the round exactly where the root CLI runs it:
+                    # after the merge publish, before serving checks.
+                    self.alert_eval.evaluate_round(
+                        self.sim.root_store.current())
                 if self.hub is not None:
                     # Deterministic engine: rounds drive the hub
                     # synchronously (the CLIs ride a StreamPump thread).
@@ -908,6 +996,29 @@ class _Run:
                 problems.append(
                     f"r{r}: two-level query merged {len(rows)} rows, want "
                     f"{len(self.membership)}")
+        if (self.scn.name == "alert_partition" and cut_leaves
+                and self.alert_eval is not None):
+            active_alerts = {
+                (row["labels"]["alertname"], row["state"])
+                for row in self.alert_eval.rows()
+            }
+            if ("TpuRootLeafPartitioned", "firing") not in active_alerts:
+                problems.append(
+                    f"r{r}: leaves cut one-sided but "
+                    f"TpuRootLeafPartitioned not firing "
+                    f"(active: {sorted(active_alerts)})")
+            if self.stream_on:
+                # The alerts route is a first-class stream shape: the
+                # polled answer must be the evaluator's rows, verbatim.
+                from tpu_pod_exporter.stream import QueryShape
+
+                env = self._stream_poll(QueryShape(route="alerts"), 0)
+                rows = env.get("data", {}).get("result", [])
+                if rows != self.alert_eval.rows():
+                    problems.append(
+                        f"r{r}: alerts stream route disagrees with the "
+                        f"evaluator ({len(rows)} rows vs "
+                        f"{len(self.alert_eval.rows())})")
         if self.scn.name == "recv_outage" and any(
                 ev.kind == "recv_outage" and ev.end_round - 1 == r
                 for ev in self.events):
@@ -1134,6 +1245,11 @@ class _Run:
             self.sim.run_round()
             if self.shipper is not None:
                 self.shipper.on_snapshot(self.sim.root_store.current())
+            if self.alert_eval is not None:
+                # Keep evaluating through settle: resolution (and its
+                # notifications) must happen for the alert verdict below.
+                self.alert_eval.evaluate_round(
+                    self.sim.root_store.current())
             if self.gov is not None:
                 self.gov.tick()
                 gs = self.gov.stats()
@@ -1335,7 +1451,99 @@ class _Run:
                     f"egress re-sent acked data: "
                     f"{len(ledger['duplicate_seqs'])} duplicate batches, "
                     f"{ledger['duplicate_samples']} duplicate samples")
+
+        if self.alert_eval is not None:
+            self._finish_alerts(result)
         return not self.problems
+
+    def _finish_alerts(self, result: dict) -> None:
+        """The alerting verdict: exactly the expected alerts fired (and
+        NO others), everything resolved after heal + settle, the webhook
+        ledger is contiguous exactly-once after the backlog drains, and
+        the firing window is answerable as ALERTS series from the store
+        (source=store — honest tags, no live plane involved)."""
+        from tpu_pod_exporter.alerting import FIRING
+
+        expected = set(self.scn.expected_alerts or ())
+        tag = ("" if self.alert_suppression
+               else " (suppression OFF — negative control)")
+        fired = {
+            str(t["alert"])
+            for t in self.alert_eval.transitions(limit=10_000)
+            if t["to"] == FIRING
+        }
+        if fired != expected:
+            self.problems.append(
+                f"alerts fired {sorted(fired)}, want exactly "
+                f"{sorted(expected)} — 'the right alerts, and no "
+                f"others' broken{tag}")
+        firing, pending = self.alert_eval.counts()
+        if firing or pending:
+            self.problems.append(
+                f"{firing} firing / {pending} pending alert instances "
+                f"left after heal + settle (resolution never came){tag}")
+        drained = self._await_alert_drain()
+        nstats = self.alert_notifier.stats()
+        with self._alert_ledger_lock:
+            seqs = sorted(self.alert_ledger)
+        result["alerts"] = {
+            "fired": sorted(fired),
+            "expected": sorted(expected),
+            "suppressed": self.alert_eval.stats()["suppressed_total"],
+            "notifications": nstats["enqueued"],
+            "delivered": len(seqs),
+            "failed_sends": nstats["failed"],
+            "breaker_reopens": nstats["breaker_reopens"],
+            "drained": drained,
+        }
+        if not drained:
+            self.problems.append(
+                f"alert notification backlog failed to drain after heal "
+                f"({nstats['backlog_records']} records stuck, breaker "
+                f"{nstats['breaker_state']})")
+        if self.scn.name == "alert_partition" and nstats["failed"] < 1:
+            # The drill's outage window covers the partition onset: the
+            # firing notifications MUST have hit the dead webhook and
+            # buffered. `failed` is the monotonic witness (breaker
+            # reopens reset once post-heal probation successes land); a
+            # zero means the wedge never happened and the exactly-once
+            # claim went untested.
+            self.problems.append(
+                "alert notifier never saw a failed send — the outage "
+                "window missed every notification, backlog/drain "
+                "untested")
+        if seqs != list(range(1, len(seqs) + 1)):
+            self.problems.append(
+                f"alert ledger not contiguous exactly-once: {seqs[:6]}…")
+        elif drained and nstats["enqueued"] != len(seqs):
+            self.problems.append(
+                f"alert notification loss: {nstats['enqueued']} framed, "
+                f"{len(seqs)} delivered")
+        if self.store is not None and expected:
+            env = self.plane.query_range(
+                "ALERTS", start=self.start_wall, end=time.time(),
+                step=0.0, source="store")
+            rows = env.get("data", {}).get("result", [])
+            names = {
+                (row.get("labels") or {}).get("alertname")
+                for row in rows if isinstance(row, dict)
+            }
+            if not expected <= names:
+                self.problems.append(
+                    f"ALERTS series missing from the store: have "
+                    f"{sorted(n for n in names if n)}, want at least "
+                    f"{sorted(expected)}")
+
+    def _await_alert_drain(self, timeout_s: float = 10.0) -> bool:
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            s = self.alert_notifier.stats()
+            with self._alert_ledger_lock:
+                delivered = len(self.alert_ledger)
+            if s["backlog_records"] == 0 and delivered >= s["enqueued"]:
+                return True
+            time.sleep(0.1)
+        return False
 
     def _check_store_continuity(self) -> None:
         """The store_continuity drill's boundary invariant, run with the
@@ -1441,6 +1649,8 @@ class _Run:
         self.plane.close()
         if self.shipper is not None:
             self.shipper.close()
+        if self.alert_eval is not None:
+            self.alert_eval.close()  # closes the notifier + its WAL
         if self.receiver is not None:
             self.receiver.stop()
         self.sim.close()
@@ -1449,19 +1659,20 @@ class _Run:
 def run_scenarios(names: list[str], n_targets: int, shards: int,
                   chips: int, state_root: str, seed: int,
                   governor: bool = True, store: bool = True,
-                  stream: bool = True) -> dict:
+                  stream: bool = True,
+                  alert_suppression: bool = True) -> dict:
     """Run the named scenarios back to back, each on a fresh stack (own
     state dir under ``state_root``); returns the summary dict the demo
     prints and writes as the CI artifact. ``governor=False`` is the
     pressure drills' negative control, ``store=False`` the
-    store-continuity drill's, and ``stream=False`` the dashboard-storm
-    drill's: the invariants still run, and the run is EXPECTED to fail
-    them."""
+    store-continuity drill's, ``stream=False`` the dashboard-storm
+    drill's, and ``alert_suppression=False`` the alert drills': the
+    invariants still run, and the run is EXPECTED to fail them."""
     os.makedirs(state_root, exist_ok=True)
     summary: dict = {
         "ok": True, "targets": n_targets, "shards": shards,
         "seed": seed, "governor": governor, "store": store,
-        "stream": stream,
+        "stream": stream, "alert_suppression": alert_suppression,
         "scenarios": {},
     }
     all_traces: dict[str, list] = {}
@@ -1470,7 +1681,8 @@ def run_scenarios(names: list[str], n_targets: int, shards: int,
         t0 = time.monotonic()
         run = _Run(scn, n_targets, shards, chips,
                    os.path.join(state_root, name), seed,
-                   governor=governor, store=store, stream=stream)
+                   governor=governor, store=store, stream=stream,
+                   alert_suppression=alert_suppression)
         result = run.run()
         result["wall_s"] = round(time.monotonic() - t0, 2)
         all_traces[name] = run.trace
@@ -1535,6 +1747,16 @@ def main(argv: list[str] | None = None) -> int:
                         "subscriptions cannot register, the invariants "
                         "still run and the drill is expected to FAIL "
                         "(CI asserts the non-zero exit)")
+    p.add_argument("--alert-suppression", default="on",
+                   choices=("on", "off"),
+                   help="off = the alert drills' NEGATIVE CONTROL: "
+                        "deliberately broken suppression — "
+                        "TpuRootLeafDown fires alongside "
+                        "TpuRootLeafPartitioned during a one-sided cut, "
+                        "the fired-set assertion ('exactly the right "
+                        "alerts, and no others') still runs and the "
+                        "drill is expected to FAIL (CI asserts the "
+                        "non-zero exit)")
     p.add_argument("--log-level", default="warning")
     ns = p.parse_args(argv)
     _utils.setup_logging(ns.log_level)
@@ -1557,12 +1779,15 @@ def main(argv: list[str] | None = None) -> int:
           + (" — GOVERNOR OFF (negative control)"
              if ns.governor == "off" else "")
           + (" — STORE OFF (negative control)"
-             if ns.store == "off" else ""))
+             if ns.store == "off" else "")
+          + (" — ALERT SUPPRESSION OFF (negative control)"
+             if ns.alert_suppression == "off" else ""))
     summary = run_scenarios(names, ns.targets, ns.shards, ns.chips,
                             ns.state_root, ns.seed,
                             governor=ns.governor == "on",
                             store=ns.store == "on",
-                            stream=ns.stream == "on")
+                            stream=ns.stream == "on",
+                            alert_suppression=ns.alert_suppression == "on")
     if not summary["ok"]:
         failed = [n for n, r in summary["scenarios"].items()
                   if not r["ok"]]

@@ -1475,6 +1475,96 @@ REPLICA_SPECS: tuple[MetricSpec, ...] = (
     TPU_REPLICA_STORE_PROXIED_TOTAL,
 )
 
+# --- Native alerting plane (tpu_pod_exporter.alerting) -----------------------
+# Emitted only while an AlertEvaluator is attached to the root
+# (--alert-rules) — conditional surface, same rule as STORE_SPECS. The
+# plane's health must be auditable from the exposition alone: what is
+# firing/pending right now, how states have been transitioning, whether
+# partition suppression is holding false positives down, and whether the
+# webhook notifier is delivering or backlogging.
+
+TPU_ROOT_ALERTS_FIRING = MetricSpec(
+    name="tpu_root_alerts_firing",
+    help="Alert instances currently in the firing (or keep-firing) state across every loaded alert rule. The same instants land in the fleet store as ALERTS-shaped series for post-incident forensics.",
+    type=GAUGE,
+)
+
+TPU_ROOT_ALERTS_PENDING = MetricSpec(
+    name="tpu_root_alerts_pending",
+    help="Alert instances currently pending: their expression is true but has not yet held for the rule's `for` duration.",
+    type=GAUGE,
+)
+
+TPU_ROOT_ALERT_TRANSITIONS_TOTAL = MetricSpec(
+    name="tpu_root_alert_transitions_total",
+    help="Alert state-machine transitions since start, by alert name and destination state (to: pending | firing | resolved). Flap damping (`keep_firing`) absorbs brief recoveries, so a high rate here means genuinely flapping conditions.",
+    type=COUNTER,
+    label_names=("alert", "to"),
+)
+
+TPU_ROOT_ALERT_SUPPRESSED_TOTAL = MetricSpec(
+    name="tpu_root_alert_suppressed_total",
+    help="Alert-instance evaluations suppressed since start, by alert name: the rule's suppress() expression matched (e.g. the root's stale-serve partition suspicion covering the instance), so a would-be pending/firing state was held down as a presumed false positive.",
+    type=COUNTER,
+    label_names=("alert",),
+)
+
+TPU_ROOT_ALERT_RULES = MetricSpec(
+    name="tpu_root_alert_rules",
+    help="Alert rules loaded from --alert-rules and evaluated each root merge round.",
+    type=GAUGE,
+)
+
+TPU_ROOT_ALERT_EVAL_FAILURES_TOTAL = MetricSpec(
+    name="tpu_root_alert_eval_failures_total",
+    help="Alert-rule evaluations that raised (absent families feeding arithmetic, bad samples). The failing rule is skipped for that round, the others still evaluate; a sustained rate flips /readyz's alerting detail to degraded.",
+    type=COUNTER,
+)
+
+TPU_ROOT_ALERT_NOTIFICATIONS_SENT_TOTAL = MetricSpec(
+    name="tpu_root_alert_notifications_sent_total",
+    help="Webhook notifications acknowledged by the receiver since start (2xx — the exactly-once cursor advanced past them; they are never re-sent, even across a root restart).",
+    type=COUNTER,
+)
+
+TPU_ROOT_ALERT_NOTIFICATIONS_FAILED_TOTAL = MetricSpec(
+    name="tpu_root_alert_notifications_failed_total",
+    help="Webhook notification attempts that failed since start (timeout, connection error, 5xx, 429). Failed notifications stay in the durable backlog and retry behind the notifier breaker.",
+    type=COUNTER,
+)
+
+TPU_ROOT_ALERT_NOTIFIER_BACKLOG_BYTES = MetricSpec(
+    name="tpu_root_alert_notifier_backlog_bytes",
+    help="On-disk bytes of alert notifications buffered under --alert-dir awaiting webhook delivery (grows through a receiver outage, drains exactly-once on recovery).",
+    type=GAUGE,
+)
+
+TPU_ROOT_ALERT_NOTIFIER_BACKLOG_AGE_SECONDS = MetricSpec(
+    name="tpu_root_alert_notifier_backlog_age_seconds",
+    help="Age of the oldest alert notification still awaiting webhook delivery. 0 with an empty backlog; a growing value means the webhook receiver has been down that long.",
+    type=GAUGE,
+)
+
+TPU_ROOT_ALERT_NOTIFIER_BREAKER_STATE = MetricSpec(
+    name="tpu_root_alert_notifier_breaker_state",
+    help="Webhook notifier circuit-breaker state (0=closed 1=open 2=half_open). Open means notifications are WAL-buffered, not flowing; /readyz reports alerting degraded after repeated reopens but stays 200 — a down webhook must not pull the root from scrape rotation.",
+    type=GAUGE,
+)
+
+ALERT_SPECS: tuple[MetricSpec, ...] = (
+    TPU_ROOT_ALERTS_FIRING,
+    TPU_ROOT_ALERTS_PENDING,
+    TPU_ROOT_ALERT_TRANSITIONS_TOTAL,
+    TPU_ROOT_ALERT_SUPPRESSED_TOTAL,
+    TPU_ROOT_ALERT_RULES,
+    TPU_ROOT_ALERT_EVAL_FAILURES_TOTAL,
+    TPU_ROOT_ALERT_NOTIFICATIONS_SENT_TOTAL,
+    TPU_ROOT_ALERT_NOTIFICATIONS_FAILED_TOTAL,
+    TPU_ROOT_ALERT_NOTIFIER_BACKLOG_BYTES,
+    TPU_ROOT_ALERT_NOTIFIER_BACKLOG_AGE_SECONDS,
+    TPU_ROOT_ALERT_NOTIFIER_BREAKER_STATE,
+)
+
 # The rollup surface the aggregator's remote-write egress ships
 # (tpu_pod_exporter.egress): the slice/multislice/workload rollups plus
 # per-target up — the "what is the fleet doing" set a central TSDB wants,

@@ -381,6 +381,15 @@ class Scenario:
     # GPU node pools (gpu_* node surface, family="gpu" rollups). 0 keeps
     # the farm homogeneous — every pre-GPU drill runs byte-identically.
     gpu_slices: int = 0
+    # Alerting teeth: when non-None the engine attaches an in-root
+    # AlertEvaluator (tpu_pod_exporter.alerting) with the drill rule set
+    # and asserts at finish that EXACTLY this set of alert names reached
+    # firing — no more, no fewer. () means "alerting on, nothing may
+    # fire". None keeps the drill alert-free (pre-alerting drills run
+    # byte-identically). --alert-suppression off is the negative
+    # control: suppression is disabled, the suppressed alert fires too,
+    # and the fired-set assertion must FAIL.
+    expected_alerts: tuple[str, ...] | None = None
 
     def events(self) -> list[ScenarioEvent]:
         return parse_scenario(self.timeline)
@@ -399,7 +408,12 @@ SCENARIOS: dict[str, Scenario] = {
                 "Every leaf unreachable from the root for 3 rounds: the "
                 "root must keep serving last-known shard data (stale-but-"
                 "labeled, leaf_up=0, staleness growing), flip /readyz to "
-                "degraded, and converge back to oracle-equal after heal."
+                "degraded, and converge back to oracle-equal after heal. "
+                "No alert assertion here: staggered post-heal "
+                "re-admission makes one twin reachable while the other "
+                "is still quarantined — honestly one-sided to the root, "
+                "so partition suspicion transiently (and correctly) "
+                "latches. The clean alert drills are the asymmetric ones."
             ),
         ),
         Scenario(
@@ -412,6 +426,7 @@ SCENARIOS: dict[str, Scenario] = {
                 "suspicion attributable per cut leaf, and the two-level "
                 "query plane stays partial-free."
             ),
+            expected_alerts=("TpuRootLeafPartitioned",),
         ),
         Scenario(
             name="partition_flapping",
@@ -565,9 +580,37 @@ SCENARIOS: dict[str, Scenario] = {
                 "The remote-write receiver answers 503 for 4 rounds: the "
                 "egress breaker opens (attributable from the egress "
                 "exposition), the backlog buffers to disk, and the drain "
-                "after heal delivers every batch exactly once."
+                "after heal delivers every batch exactly once. No leaf "
+                "is cut, so NO alert may fire — the empty expected set "
+                "is asserted, not assumed."
             ),
             settle_rounds=4,
+            expected_alerts=(),
+        ),
+        Scenario(
+            name="alert_partition",
+            timeline=("partition(leaf<->root, asymmetric)@3+5; "
+                      "recv_outage()@2+4"),
+            description=(
+                "The alerting-teeth drill: an asymmetric cut makes every "
+                "cut leaf look down (leaf_up=0) while its HA twin proves "
+                "the pod is alive (partition_suspected=1) — "
+                "TpuRootLeafPartitioned must fire, TpuRootLeafDown must "
+                "be suppressed, and nothing else may fire. The receiver "
+                "outage covers the partition onset, so the firing "
+                "notifications wedge the alert webhook too: "
+                "notifications buffer through the WAL-backed backlog and "
+                "the post-heal drain must land a contiguous exactly-once "
+                "ledger. The firing states ride the FleetStore as ALERTS "
+                "series (queryable source=store) and the stream plane's "
+                "alerts route must agree with the evaluator. "
+                "--alert-suppression off is the negative control: "
+                "TpuRootLeafDown fires as well and the fired-set "
+                "assertion must FAIL (CI asserts the non-zero exit)."
+            ),
+            settle_rounds=4,
+            uses_store=True,
+            expected_alerts=("TpuRootLeafPartitioned",),
         ),
     )
 }

@@ -659,6 +659,7 @@ class RootAggregator:
         breaker_store: Any = None,  # persist.BreakerStateFile | None
         stale_serve_s: float = 0.0,
         fleet_store: Any = None,  # store.FleetStore | None
+        alert_evaluator: Any = None,  # alerting.AlertEvaluator | None
         render_splice: bool = True,  # --render-splice; RUNBOOK kill switch
     ) -> None:
         if not topology:
@@ -725,6 +726,11 @@ class RootAggregator:
         # store's downsample tiers, and the tpu_root_store_* surface rides
         # this root's exposition. Owned here for lifecycle (close()).
         self._fleet_store = fleet_store
+        # Native alerting plane (tpu_pod_exporter.alerting): evaluated
+        # each round against the just-published snapshot, AFTER the store
+        # append (alerts may reference recording-rule outputs the same
+        # round computed). Owned here for lifecycle (close()).
+        self.alert_evaluator = alert_evaluator
         self._last_views: dict[str, tuple[LeafView, float]] = {}
         # Last round's health summary, read by ready_detail() from HTTP
         # threads (swapped atomically as a tuple).
@@ -954,6 +960,16 @@ class RootAggregator:
             except Exception as e:  # noqa: BLE001 — history must not break merging
                 self._rlog.warning("fleet_store",
                                    "fleet store append failed: %s", e)
+        if self.alert_evaluator is not None:
+            # Same seat, same rule: rides the round thread (the
+            # evaluator's single-caller contract) but never fails a
+            # round — a broken rule degrades /readyz detail, not merging.
+            try:
+                self.alert_evaluator.evaluate_round(
+                    self._store.current(), now_wall=now_wall)
+            except Exception as e:  # noqa: BLE001 — alerting must not break merging
+                self._rlog.warning("alerting",
+                                   "alert evaluation failed: %s", e)
         for hook in self.round_hooks:
             try:
                 hook(self.rounds)
@@ -1139,6 +1155,11 @@ class RootAggregator:
                    if stale_served else "")
                 + "; root-side network partition suspected"
             ]
+        if self.alert_evaluator is not None:
+            # `alerting: ok|degraded` — detail only, NEVER the HTTP code:
+            # a down webhook receiver must not pull the root from scrape
+            # rotation.
+            out["alerting"] = self.alert_evaluator.ready_detail()
         return out
 
     def debug_vars(self) -> dict:
@@ -1152,6 +1173,8 @@ class RootAggregator:
             "render": tmpl.stats() if tmpl is not None else None,
             "store": (self._fleet_store.stats()
                       if self._fleet_store is not None else None),
+            "alerting": (self.alert_evaluator.stats()
+                         if self.alert_evaluator is not None else None),
             "stale_serve_s": self._stale_serve_s,
             "stale_view_bytes": self.stale_view_bytes(),
             "stale_served_leaves": self._health[2],
@@ -1175,6 +1198,11 @@ class RootAggregator:
     def close(self) -> None:
         self._leaf_set.maybe_save_breakers(force=True)
         self._pool.shutdown(wait=False)
+        if self.alert_evaluator is not None:
+            try:
+                self.alert_evaluator.close()
+            except Exception:  # noqa: BLE001 — draining must finish
+                pass
         if self._fleet_store is not None:
             try:
                 self._fleet_store.close()
@@ -1761,6 +1789,37 @@ def main(argv: list[str] | None = None) -> int:
                         "stored series so dashboards hit precomputed "
                         "rollups instead of fan-outs; malformed rules "
                         "fail startup loudly")
+    p.add_argument("--alert-rules", default="",
+                   help="[root] native alerting-rule file: 'alert NAME = "
+                        "<expr>' blocks with indented for/keep_firing/"
+                        "labels/annotations/suppress clauses, evaluated "
+                        "at the root each merge round (no external "
+                        "Prometheus on the incident path); malformed "
+                        "rules or unknown metric names fail startup "
+                        "loudly. Generate one from prometheus-rules.yaml "
+                        "with `python -m tpu_pod_exporter.alerting "
+                        "--import`. Empty disables alerting")
+    p.add_argument("--alert-dir", default="",
+                   help="[root] alerting state dir: the alert-status.json "
+                        "sidecar (status --tree reads it) and the "
+                        "notification WAL + exactly-once ledger live "
+                        "here; required with --alert-webhook-url")
+    p.add_argument("--alert-webhook-url", default="",
+                   help="[root] POST firing/resolved transitions here as "
+                        "JSON, exactly-once (WAL-buffered, seq-framed, "
+                        "breaker-gated; outages backlog on disk and "
+                        "drain contiguously across root restarts). "
+                        "Empty = evaluate + record + stream, no "
+                        "notifications")
+    p.add_argument("--alert-webhook-timeout-s", type=float, default=5.0,
+                   help="[root] per-notification webhook POST timeout")
+    p.add_argument("--alert-suppression", default="on",
+                   choices=("on", "off"),
+                   help="[root] honor rules' suppress(...) clauses (the "
+                        "partition false-positive guard). 'off' is the "
+                        "drill negative control and an incident kill "
+                        "switch — suppressed_total goes quiet and every "
+                        "condition fires raw")
     p.add_argument("--store-max-disk-mb", type=float, default=0.0,
                    help="[root] disk budget over the store dir, enforced "
                         "by the pressure governor: past it the disk "
@@ -1809,7 +1868,8 @@ def _serve_until_signal(loop: Any, server: Any,
 
 
 def _attach_stream_cli(ns: argparse.Namespace, agg: Any,
-                       plane: Any) -> tuple[Any, Any]:
+                       plane: Any,
+                       alerts_fn: Any = None) -> tuple[Any, Any]:
     """Stream-hub wiring shared by every role: (hub, pump), or (None,
     None) with --stream off or no query plane to answer through."""
     if ns.stream != "on" or plane is None:
@@ -1821,6 +1881,7 @@ def _attach_stream_cli(ns: argparse.Namespace, agg: Any,
         heartbeat_s=ns.stream_heartbeat_s,
         full_sync_s=ns.stream_full_sync_s,
         max_subscribers=ns.stream_max_subscribers,
+        alerts_fn=alerts_fn,
     )
 
 
@@ -1967,6 +2028,53 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
             register_store_rungs(governor, fleet_store)
             fleet_store.disk_budget_bytes = budget
             governor.start()
+    # Native alerting plane: rules parse + validate BEFORE the first
+    # round (a typo'd rule file is a startup error, never a silent
+    # no-op), the notifier replays its WAL before the evaluator can
+    # enqueue (backlog from a previous run drains first, in seq order).
+    evaluator: Any = None
+    if not ns.alert_rules and (ns.alert_dir or ns.alert_webhook_url):
+        p.error("--alert-dir/--alert-webhook-url require --alert-rules "
+                "(no alerting plane is configured)")
+    if ns.alert_rules:
+        from tpu_pod_exporter.alerting import (
+            AlertEvaluator,
+            AlertNotifier,
+            load_alert_rules_file,
+        )
+
+        if ns.alert_webhook_url and not ns.alert_dir:
+            p.error("--alert-webhook-url needs --alert-dir (the "
+                    "notification WAL and exactly-once ledger live "
+                    "there)")
+        notifier: Any = None
+        try:
+            alert_rules = load_alert_rules_file(ns.alert_rules)
+            if ns.alert_dir:
+                os.makedirs(ns.alert_dir, exist_ok=True)
+            if ns.alert_webhook_url:
+                notifier = AlertNotifier(
+                    ns.alert_webhook_url, ns.alert_dir,
+                    timeout_s=ns.alert_webhook_timeout_s)
+                notifier.load()
+                notifier.start()
+            evaluator = AlertEvaluator(
+                alert_rules,
+                alert_dir=ns.alert_dir or None,
+                notifier=notifier,
+                store=fleet_store,
+                recording_rules=(fleet_store.rules
+                                 if fleet_store is not None else ()),
+                suppression=ns.alert_suppression == "on",
+            )
+        except (OSError, ValueError) as e:
+            p.error(f"--alert-rules: {e}")
+        log.info("alerting plane: %d rule(s) from %s%s%s",
+                 len(alert_rules), ns.alert_rules,
+                 (f", webhook {ns.alert_webhook_url}"
+                  if ns.alert_webhook_url else ", no webhook"),
+                 ("" if ns.alert_suppression == "on"
+                  else " [suppression OFF]"))
     root = RootAggregator(
         topology, store, timeout_s=ns.timeout_s,
         loop_overruns_fn=lambda: loop.overruns,
@@ -1976,8 +2084,11 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         breaker_store=breaker_store,
         stale_serve_s=ns.stale_serve_s,
         fleet_store=fleet_store,
+        alert_evaluator=evaluator,
         render_splice=ns.render_splice == "on",
     )
+    if evaluator is not None:
+        root.emit_hooks.append(evaluator.emit)
     plane: Any = None
     inner_plane: Any = None
     if ns.fleet_query == "on":
@@ -1991,7 +2102,9 @@ def _run_root(ns: argparse.Namespace, p: argparse.ArgumentParser) -> int:
         # Source-aware front: live fan-out + store fills (store-only when
         # --fleet-query off). Serves through the same server hook.
         plane = StoreQueryPlane(plane, fleet_store)
-    hub, pump = _attach_stream_cli(ns, root, plane)
+    hub, pump = _attach_stream_cli(
+        ns, root, plane,
+        alerts_fn=(evaluator.rows if evaluator is not None else None))
     if ns.memory_budget_mb > 0:
         from tpu_pod_exporter.pressure import build_serving_governor
 

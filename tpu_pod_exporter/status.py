@@ -64,14 +64,22 @@ def main(argv=None) -> int:
                           "store's store-status.json sidecar from this "
                           "dir and append a store: footer (retention "
                           "span, disk vs budget, rules, last-append age)")
+    pre.add_argument("--alert-dir", default="",
+                     help="with --tree on the root host: read the "
+                          "alerting plane's alert-status.json sidecar "
+                          "from this dir and append an alerts: footer "
+                          "(firing/pending counts, newest transition "
+                          "age, notifier backlog + breaker)")
     ns, rest = pre.parse_known_args(argv)
     if ns.tree:
         try:
             if ns.watch <= 0:
                 return _run_tree(ns.tree, as_json=ns.json,
-                                 store_dir=ns.store_dir)
+                                 store_dir=ns.store_dir,
+                                 alert_dir=ns.alert_dir)
             return _watch_tree(ns.tree, ns.watch, as_json=ns.json,
-                               store_dir=ns.store_dir)
+                               store_dir=ns.store_dir,
+                               alert_dir=ns.alert_dir)
         except KeyboardInterrupt:
             return 0
     if ns.fleet:
@@ -420,6 +428,13 @@ def render_tree(doc: dict) -> str:
         # A typo'd --store-dir must look different from "no store
         # configured" — the forensics playbook starts here.
         out.append(f"store: {doc['store_error']}")
+    alerts = doc.get("alerts")
+    if alerts is not None:
+        out.append(alert_line(alerts))
+    elif doc.get("alerts_error"):
+        # Same discipline as store_error: a typo'd --alert-dir must look
+        # different from "no alerting configured".
+        out.append(f"alerts: {doc['alerts_error']}")
     return "\n".join(out)
 
 
@@ -456,6 +471,42 @@ def store_line(doc: dict) -> str:
     series = doc.get("series")
     if series is not None:
         parts.append(f"{series:g} series")
+    return " · ".join(parts)
+
+
+def alert_line(doc: dict) -> str:
+    """``alerts:`` footer from the alerting plane's on-disk sidecar
+    (tpu_pod_exporter.alerting.alert_status_summary): firing/pending
+    counts, newest transition age, suppression/evaluation health and the
+    notifier's backlog + breaker — what the alerting triage playbook
+    reads first."""
+    firing = doc.get("firing") or 0
+    pending = doc.get("pending") or 0
+    parts = [f"alerts: {firing:g} firing · {pending:g} pending "
+             f"· rules {doc.get('rules', 0):g}"]
+    last = doc.get("last_transition_wall")
+    if last:
+        parts.append(
+            f"last transition {max(time.time() - last, 0.0):.1f}s ago")
+    if not doc.get("suppression", True):
+        parts.append("SUPPRESSION OFF")
+    suppressed = doc.get("suppressed_total") or 0
+    if suppressed:
+        parts.append(f"suppressed {suppressed:g}")
+    failures = doc.get("eval_failures") or 0
+    if failures:
+        parts.append(f"EVAL FAILURES {failures:g}")
+    notif = doc.get("notifier")
+    if notif:
+        backlog = notif.get("backlog_records") or 0
+        cell = f"notify sent {notif.get('sent', 0):g}"
+        if backlog:
+            cell += (f" backlog {backlog:g} "
+                     f"({notif.get('backlog_age_s', 0.0):.0f}s old)")
+        breaker = notif.get("breaker")
+        if breaker and breaker != "closed":
+            cell += f" breaker {str(breaker).upper()}"
+        parts.append(cell)
     return " · ".join(parts)
 
 
@@ -498,8 +549,24 @@ def _attach_store(doc: dict, store_dir: str) -> dict:
     return doc
 
 
+def _attach_alerts(doc: dict, alert_dir: str) -> dict:
+    """Attach the alerting sidecar summary under ``doc["alerts"]`` (the
+    store-footer discipline: absent dir attaches nothing, a configured
+    but unreadable sidecar attaches an explicit error)."""
+    if alert_dir:
+        from tpu_pod_exporter.alerting import alert_status_summary
+
+        summary = alert_status_summary(alert_dir)
+        if summary is not None:
+            doc["alerts"] = summary
+        else:
+            doc["alerts_error"] = (
+                f"no alert-status.json under {alert_dir}")
+    return doc
+
+
 def _watch_tree(addr: str, interval_s: float, as_json=False,
-                store_dir: str = "") -> int:
+                store_dir: str = "", alert_dir: str = "") -> int:
     """``--tree --watch``: re-render until interrupted, surviving root
     outages with a last-known-state footer instead of exiting. The store
     sidecar is re-read every interval — a thinning or append-failing
@@ -530,7 +597,8 @@ def _watch_tree(addr: str, interval_s: float, as_json=False,
     while True:
         error = None
         try:
-            doc = _attach_store(fetch_tree(addr), store_dir)
+            doc = _attach_alerts(
+                _attach_store(fetch_tree(addr), store_dir), alert_dir)
             last_doc = doc
             last_ok = time.monotonic()
         except Exception as e:  # noqa: BLE001 — watch mode outlives outages
@@ -569,11 +637,13 @@ def _watch_tree(addr: str, interval_s: float, as_json=False,
             time.sleep(interval_s)
 
 
-def _run_tree(addr: str, as_json=False, store_dir: str = "") -> int:
+def _run_tree(addr: str, as_json=False, store_dir: str = "",
+              alert_dir: str = "") -> int:
     import json as _json
 
     try:
-        doc = _attach_store(fetch_tree(addr), store_dir)
+        doc = _attach_alerts(
+            _attach_store(fetch_tree(addr), store_dir), alert_dir)
     except Exception as e:  # noqa: BLE001 — a down root is the answer
         print(f"tree query against {addr} failed: {e}", file=sys.stderr)
         return 1

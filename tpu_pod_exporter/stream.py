@@ -71,7 +71,8 @@ from tpu_pod_exporter.utils import RateLimitedLogger
 
 log = logging.getLogger("tpu_pod_exporter.stream")
 
-STREAM_ROUTES: tuple[str, ...] = ("series", "query_range", "window_stats")
+STREAM_ROUTES: tuple[str, ...] = ("series", "query_range", "window_stats",
+                                 "alerts")
 
 # Frame types a data-bearing frame may carry (heartbeats repeat the last
 # seq instead of consuming one; continuity is asserted over these three).
@@ -115,7 +116,7 @@ class QueryShape:
         """JSON-able echo of the registered query (rides the snapshot
         frame so a client can prove what the server heard)."""
         doc: dict[str, Any] = {"route": self.route}
-        if self.route != "series":
+        if self.route not in ("series", "alerts"):
             doc["metric"] = self.metric
             doc["match"] = dict(self.match)
             doc["window"] = self.window_s
@@ -135,8 +136,11 @@ class QueryShape:
             raise ValueError(
                 f"route must be one of {'/'.join(STREAM_ROUTES)}"
             )
-        if route == "series":
-            return cls(route="series")
+        if route in ("series", "alerts"):
+            # Parameterless shapes: every subscriber shares one canonical
+            # identity (alerts rows are keyed by alertname + instance
+            # labels; transitions arrive as row deltas).
+            return cls(route=route)
         metric = param("metric")
         if not metric:
             raise ValueError("missing required parameter: metric")
@@ -830,14 +834,16 @@ def attach_stream(
     heartbeat_s: float = 10.0,
     full_sync_s: float = 60.0,
     max_subscribers: int = 10000,
+    alerts_fn: Callable[[], list] | None = None,
 ) -> tuple[StreamHub, "StreamPump"]:
     """Standard tier wiring: a hub answering through ``plane`` (the same
     query plane the polled /api/v1 serves), generation = the tier's round
     counter, a started pump hooked to the tier's round hook, and the
     hub's self-metrics riding the tier's publish. Used by the aggregator,
-    root and replica CLIs — one wiring path, not three twins."""
+    root and replica CLIs — one wiring path, not three twins.
+    ``alerts_fn`` (root only) feeds the ``route=alerts`` shape."""
     hub = StreamHub(
-        plane_poll_fn(plane),
+        plane_poll_fn(plane, alerts_fn=alerts_fn),
         generation_fn=lambda: agg.rounds,
         heartbeat_s=heartbeat_s,
         full_sync_s=full_sync_s,
@@ -927,14 +933,21 @@ def _env_rows(route: str, env: Mapping[str, Any]) -> list:
 
 def plane_poll_fn(plane: Any,
                   wallclock: Callable[[], float] = time.time,
+                  alerts_fn: Callable[[], list] | None = None,
                   ) -> Callable[[QueryShape, int], dict]:
     """Adapter: a fleet-like query plane (``series``/``query_range``/
     ``window_stats``) → the hub's ``poll_fn``. The trailing window is
     re-anchored at now each round; the plane's own grid alignment and
-    generation-keyed cache make repeated evaluations cheap."""
+    generation-keyed cache make repeated evaluations cheap. ``alerts_fn``
+    feeds the ``route=alerts`` shape (the AlertEvaluator's active rows);
+    a tier with no evaluator streams an empty, never-erroring row set."""
 
     def poll(shape: QueryShape, generation: int) -> dict:  # noqa: ARG001 — the plane caches by its own generation
         match = dict(shape.match)
+        if shape.route == "alerts":
+            rows = alerts_fn() if alerts_fn is not None else []
+            return {"status": "ok", "source": "live",
+                    "data": {"result": rows}}
         if shape.route == "series":
             return plane.series()
         if shape.route == "window_stats":
@@ -957,6 +970,11 @@ def history_poll_fn(history: Any,
 
     def poll(shape: QueryShape, generation: int) -> dict:  # noqa: ARG001
         match = dict(shape.match)
+        if shape.route == "alerts":
+            # Node tier owns no evaluator — an alerts stream is legal but
+            # empty (the root is where alerting lives).
+            return {"status": "ok", "source": "live",
+                    "data": {"result": []}}
         if shape.route == "series":
             return {"status": "ok", "source": "live",
                     "data": history.series_list()}
