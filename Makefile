@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo pressure-demo store-demo dashboard-demo alert-demo clean
+.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo fuzz-smoke pressure-demo store-demo dashboard-demo alert-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -176,6 +176,18 @@ egress-drain-check:
 scenario-demo:
 	python -m tpu_pod_exporter.loadgen.scenario --targets 120 --shards 4 \
 		--state-root scenario-demo-state
+
+# Scenario fuzzer smoke (README "Scenario fuzzer"): seeded random valid
+# timelines through the full engine with every invariant armed, failures
+# ddmin-minimized to canonical DSL reproducers, (seam x invariant)
+# coverage written to fuzz-state/coverage.json and checked against the
+# chaos seam registry (any unregistered seam is a hard error). Fixed seed
+# list so CI is deterministic: any failure replays from its printed
+# `--fuzz-replay SEED:TRIAL` coordinates alone. The larger soak budget
+# lives behind `pytest -m slow` (tests/test_fuzz.py).
+fuzz-smoke:
+	python -m tpu_pod_exporter.fuzz --seeds 5,11 --trials 4 \
+		--state-root fuzz-state
 
 # Streaming dashboard plane acceptance (deploy/RUNBOOK.md "Dashboard storm
 # playbook"): 5000 concurrent /api/v1/stream subscriptions held against

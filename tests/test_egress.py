@@ -179,6 +179,47 @@ class TestWalBuffer:
         assert len(segs) <= 1
         b.close()
 
+    def test_seal_active_reclaims_acked_bytes_without_append(self, tmp_path):
+        """With a large segment cap, everything acked still sits in the
+        never-rotated active segment — and rotation is append-lazy, so a
+        stalled producer strands those bytes forever. seal_active must
+        reclaim them on demand (the disk-pressure path's fix, found by
+        the scenario fuzzer's one-round disk_full windows)."""
+        b = WalBuffer(str(tmp_path), segment_max_bytes=1 << 20)
+        b.open()
+        for i in range(20):
+            b.append(b"y" * 200)
+        while b.peek() is not None:
+            b.ack()
+        segs = [n for n in os.listdir(tmp_path) if n.startswith("seg-")]
+        assert len(segs) == 1  # acked bytes stranded in the active segment
+        freed = b.seal_active()
+        assert freed > 0
+        assert not [n for n in os.listdir(tmp_path) if n.startswith("seg-")]
+        # The sealed buffer keeps working: fresh appends land and survive.
+        b.append(b"fresh")
+        assert b.peek() == b"fresh"
+        b.close()
+        b2 = WalBuffer(str(tmp_path), segment_max_bytes=1 << 20)
+        assert b2.open()["pending"] == 1
+        b2.close()
+
+    def test_seal_active_keeps_pending_records(self, tmp_path):
+        """Sealing must never drop or re-order unacked records."""
+        b = WalBuffer(str(tmp_path), segment_max_bytes=1 << 20)
+        b.open()
+        for i in range(6):
+            b.append(b"rec-%d" % i)
+        for _ in range(2):
+            b.ack()
+        assert b.seal_active() == 0  # pending head pins the sealed segment
+        got = []
+        while b.peek() is not None:
+            got.append(b.peek())
+            b.ack()
+        assert got == [b"rec-%d" % i for i in range(2, 6)]
+        b.close()
+
     def test_drained_buffer_restart_does_not_swallow_new(self, tmp_path):
         b = WalBuffer(str(tmp_path))
         b.open()

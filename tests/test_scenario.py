@@ -554,6 +554,31 @@ def quiet_logs():
     logging.disable(logging.NOTSET)
 
 
+class TestFuzzDerivedDrills:
+    """The fuzz_* SCENARIOS entries are ddmin'd fuzzer finds promoted to
+    named regression drills; they must stay canonical so the fuzzer's
+    replay/minimize tooling round-trips them byte-for-byte."""
+
+    FUZZ_DRILLS = ("fuzz_root_restart_egress", "fuzz_hotspot_churn")
+
+    @pytest.mark.parametrize("name", FUZZ_DRILLS)
+    def test_timeline_is_canonical_fixpoint(self, name):
+        scn = sc.SCENARIOS[name]
+        assert sc.render_timeline(sc.parse_scenario(scn.timeline)) \
+            == scn.timeline
+
+    @pytest.mark.parametrize("name", FUZZ_DRILLS)
+    def test_provenance_documented(self, name):
+        scn = sc.SCENARIOS[name]
+        assert scn.uses_egress, name
+        assert "fuzz" in scn.description.lower(), (
+            "fuzz-derived drills must document their provenance")
+
+    def test_headline_find_cites_replay_coordinates(self):
+        desc = sc.SCENARIOS["fuzz_root_restart_egress"].description
+        assert "seed 1 trial 7" in desc
+
+
 class TestScenarioEngine:
     def test_asymmetric_partition_end_to_end(self, tmp_path, quiet_logs):
         from tpu_pod_exporter.loadgen.scenario import _Run

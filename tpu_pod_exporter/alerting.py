@@ -1821,6 +1821,18 @@ class AlertEvaluator:
                           if i.state == PENDING)
         return firing, pending
 
+    def suppressed_names(self) -> tuple[str, ...]:
+        """Rule names whose instances suppression held down at least once
+        over this evaluator's lifetime. The scenario fuzzer's suppress-
+        aware verdict reads this: on a GENERATED timeline an alert may
+        legitimately fire OR be suppressed, but either way its name must
+        sit inside the derived expected∪allowed envelope — a rule
+        engaging (even silently) outside that envelope means the
+        generator's alert model and the evaluator disagree."""
+        with self._lock:
+            return tuple(sorted(
+                name for name, n in self._suppressed_total.items() if n))
+
     @property
     def degraded(self) -> bool:
         """Evaluator errors in the last round, or a notifier whose

@@ -79,6 +79,88 @@ _COUNT_RE = re.compile(r"^x(\d+)$")
 _OFFSET_RE = re.compile(r"^@(\d+)$")
 
 
+# --------------------------------------------------------------- seam registry
+#
+# Every distinct place this toolbox (plus the scenario engine driving it)
+# can inject a fault, enumerable at runtime. The fuzzer's coverage ledger
+# keys its (seam × invariant) matrix off this registry and cross-checks it
+# against the DSL's kind→seam map in BOTH directions, so an injector added
+# here without a generator (or a generator naming a ghost seam) fails a
+# tier-1 test instead of being silently omitted from coverage.
+
+@dataclass(frozen=True)
+class Seam:
+    """One injection seam: a named fault surface and the mechanism that
+    cuts it (class or engine hook), for the coverage report."""
+
+    name: str
+    description: str
+
+
+SEAM_REGISTRY: dict[str, Seam] = {}
+
+
+def register_seam(name: str, description: str) -> Seam:
+    """Register one seam (module-import time, next to its injector). Loud
+    on duplicates: two injectors claiming one seam would make the
+    coverage matrix under-count."""
+    if name in SEAM_REGISTRY:
+        raise ValueError(f"chaos seam {name!r} registered twice")
+    seam = Seam(name=name, description=description)
+    SEAM_REGISTRY[name] = seam
+    return seam
+
+
+def registered_seams() -> tuple[str, ...]:
+    """Sorted seam names — the coverage matrix's row space."""
+    return tuple(sorted(SEAM_REGISTRY))
+
+
+# The wire seams PartitionState/PartitionedFetch/PartitionedSend cut, one
+# per tier edge the stack actually crosses (scenario.PARTITION_EDGES).
+register_seam("wire:node-leaf",
+              "leaf→target scrape fetches (PartitionedFetch at the leaf "
+              "poll seam)")
+register_seam("wire:leaf-root",
+              "root→leaf merge fetches + query fan-out (PartitionedFetch "
+              "at the root seam)")
+register_seam("wire:root-recv",
+              "root→receiver remote-write posts (PartitionedSend at the "
+              "egress seam)")
+# Host-level injectors.
+register_seam("wallclock",
+              "NTP-shaped wall-clock steps (ClockStepper — the egress "
+              "clock fence's subject)")
+register_seam("memory",
+              "memory-budget collapse over the byte-accounted caches "
+              "(MemoryHog / the governor's squeezed memory budget)")
+register_seam("disk",
+              "disk-budget collapse under the durable-state dirs (the "
+              "governor's squeezed disk budget)")
+register_seam("serving",
+              "aggressive keep-alive scrape load on the serving tier "
+              "(ScrapeStorm vs the admission caps)")
+register_seam("receiver",
+              "remote-write receiver outage/flap (ChaosReceiver "
+              "set_outage — breaker + backlog + exactly-once drain)")
+# Process/fleet seams the scenario engine injects through the sim.
+register_seam("target-process",
+              "target processes dying and returning (farm dead set: "
+              "preempt / restart_wave)")
+register_seam("root-process",
+              "SIGKILL-shaped root death + fresh-instance restart "
+              "(_ShardSim.kill_root/restart_root)")
+register_seam("workload",
+              "workload behavior shifts: hotspot duty/HBM spikes and "
+              "pod label churn (farm hot set / pod_gen)")
+register_seam("membership",
+              "targets-file membership churn (add/remove waves through "
+              "the shared targets file)")
+register_seam("stream",
+              "streaming dashboard subscription load against "
+              "/api/v1/stream (_StormSubscribers vs the hub caps)")
+
+
 class ChaosError(RuntimeError):
     """An injected source failure (the ``err`` fault kind)."""
 

@@ -677,8 +677,22 @@ class RemoteWriteShipper:
                     current[(name, key)] = value
         return current
 
+    def _same_poll_instant(self, wall: float) -> bool:
+        """True when a batch for this exact poll instant was already
+        framed. Every sample timestamp in a batch derives solely from the
+        snapshot's poll wall, so framing the same instant twice emits
+        identical (series, timestamp) samples under a fresh seq — the
+        receiving ledger counts them as duplicates and exactly-once is
+        gone. Reached when the poller stalls (root death, wedged store)
+        and the same frozen snapshot keeps arriving: with ``interval_s``
+        of 0 the interval gate passes (0 < 0 is false) and the heartbeat
+        ride-along would re-send at the frozen timestamp every cycle."""
+        return wall == self._last_batch_wall
+
     def _write_snapshot(self, snap: "Snapshot") -> None:
         wall = float(getattr(snap, "poll_timestamp", snap.timestamp))
+        if self._same_poll_instant(wall):
+            return
         if wall < self._last_batch_wall:
             # Wall clock stepped BACKWARDS (NTP correction): without this
             # clamp the interval gate `wall - last < interval` stays
@@ -1011,6 +1025,12 @@ class RemoteWriteShipper:
         self.buffer.segment_max_bytes = (
             SHED_SEGMENT_BYTES if on else self._normal_segment_bytes
         )
+        if on:
+            # Reclaim acked bytes NOW, not at the next append: with the
+            # producer stalled, the lazily-rotated active segment can be
+            # 100% acked yet hold the disk over budget forever (the
+            # fuzzer's one-round disk_full find).
+            self.buffer.seal_active()
         self._work.set()  # wake the sender so the cap applies promptly
 
     def set_pressure_hook(self, hook: Callable[[BaseException], bool]) -> None:

@@ -67,6 +67,7 @@ from tpu_pod_exporter.metrics import schema
 from tpu_pod_exporter.metrics.parse import parse_families
 from tpu_pod_exporter.scenario import (
     DEFAULT_SCENARIO_ORDER,
+    INVARIANTS,
     SCENARIOS,
     Scenario,
     ScenarioEvent,
@@ -76,6 +77,12 @@ from tpu_pod_exporter.scenario import (
 # Wall-clock staleness slack for "fresh" tiers: the drills run subsecond
 # rounds, so anything beyond this means a tier silently stopped merging.
 FRESH_STALENESS_BUDGET_S = 8.0
+
+# INVARIANTS (imported above, re-exported here) names the engine's
+# invariant families; _Run tracks which were actually ARMED per run — a
+# fuzz trial only counts coverage for invariants that could have failed
+# it.
+__all__ = ["INVARIANTS", "run_one", "run_scenarios", "main"]
 
 # The alert drills' rule set (tpu_pod_exporter.alerting grammar). Both
 # rules fire IMMEDIATELY (no `for` clause): engine rounds are subsecond
@@ -194,6 +201,12 @@ class _Run:
         if dash_counts and governor:
             conn_cap = max(conn_cap, 2 * max(dash_counts) + 16)
             client_cap = 0
+        # The EFFECTIVE cap, saved for the storm invariant: a composed
+        # dashboard storm raises it above STORM_CONN_CAP, and a generated
+        # storm smaller than it legitimately draws zero 429s (the fuzzer's
+        # sub-cap scrape_storm find — the old check hardcoded the class
+        # constant and demanded rejections from ANY storm).
+        self.conn_cap = conn_cap
         self.root_server = MetricsServer(
             self.sim.root_store, host="127.0.0.1", port=0,
             ready_detail_fn=lambda: self.sim.root.ready_detail(),
@@ -350,6 +363,12 @@ class _Run:
         self.disk_budget_target = 0
         self.disk_batch_est = 4096
         self.mem_budget_target = 0
+        # Accounted memory at the last verified quiet round: the WARM
+        # steady state. A mem_pressure window that opens right after a
+        # root restart would otherwise derive its budget from a cold
+        # cache (fuzzer find: root_restart()@2; mem_pressure()@3+2 set
+        # an unmeetable budget the legitimate warm-up then breached).
+        self.mem_accounted_baseline = 0
         self.storm: ScrapeStorm | None = None
         self.storm_baseline_p99: float | None = None
         self.storm_p99s: list[float] = []
@@ -367,6 +386,12 @@ class _Run:
         # Targets healed from an injected outage but possibly still
         # quarantined leaf-side; they must come back before the run ends.
         self.recovering: set[str] = set()
+        # Targets seen healthy ONCE since their fault ended; pruned from
+        # `recovering` only on a second consecutive healthy check. The
+        # HA freshest-wins merge can flap a just-revived target back to
+        # down for one round under load — one healthy sighting is not
+        # yet recovery (fuzzer find, load-dependent).
+        self._recovered_once: set[str] = set()
         # Same for leaves after a root-leaf cut heals: the root's leaf
         # breaker holds its quarantine until the next half-open probe —
         # bounded by the settle loop, not an instant flip.
@@ -384,6 +409,18 @@ class _Run:
         self.restart_wall = 0.0
         self.trace: list[dict] = []
         self.problems: list[str] = []
+        # Which invariant families this run can actually fail on — the
+        # fuzzer's coverage ledger records (seam × invariant) only for
+        # armed invariants, so a store-off run never claims ledger
+        # coverage it didn't buy. oracle_equality arms lazily, on the
+        # first compare that actually executes.
+        self.invariants_armed: set[str] = {
+            "bounded_staleness", "fault_attribution", "series_rss_leaks",
+        }
+        if self.shipper is not None:
+            self.invariants_armed.add("egress_ledger")
+        if self.alert_eval is not None:
+            self.invariants_armed.add("alerts_correctness")
 
     # --------------------------------------------------------- store helpers
 
@@ -569,14 +606,7 @@ class _Run:
             farm.dead |= ev_state
             self._preempt_victims = ev_state
         elif ev.kind == "hotspot":
-            # Resolved against the pod mapping at window start; the DSL's
-            # overlap rule keeps a concurrent churn_storm (which rotates
-            # pod names) out of the same timeline only by convention —
-            # composing them would need per-tick re-resolution here.
-            farm.hot = {
-                i for i in self._member_indices()
-                if farm.pod_of(i) == ev.subject
-            }
+            self._resolve_hotspot(ev)
         elif ev.kind == "restart_wave":
             live = sorted(
                 i for i in self._member_indices() if i not in farm.dead
@@ -608,11 +638,16 @@ class _Run:
             if self.gov is not None:
                 self.gov.set_disk_budget_bytes(self.disk_budget_target)
         elif ev.kind == "mem_pressure":
-            # Budget = current accounted + one small delta: the query
+            # Budget = WARM accounted + one small delta: the query
             # traffic the window drives adds far more than the delta, so
             # governor-off breaches deterministically while governor-on
-            # (caches cleared + disabled) stays under.
-            self.mem_budget_target = self._accounted_memory() + 2048
+            # (caches cleared + disabled) stays under. The quiet-round
+            # baseline floors the reference — sampling a cold cache
+            # right after a root restart would set a budget the
+            # legitimate warm-up alone breaches.
+            self.mem_budget_target = max(
+                self._accounted_memory(), self.mem_accounted_baseline
+            ) + 2048
             if self.gov is not None:
                 self.gov.set_memory_budget_bytes(self.mem_budget_target)
         elif ev.kind == "scrape_storm":
@@ -657,6 +692,17 @@ class _Run:
                         self.recovering_leaves.update(self.sim.leaves)
                     else:
                         self.recovering_leaves.add(dst.split(":", 1)[1])
+                if dst == "node" or src == "node":
+                    # A healed node-tier cut leaves every member target's
+                    # scrape breaker open until its next probe; that
+                    # post-heal darkness is attributable to THIS cut, not
+                    # an unexplained outage (fuzzer find: a bare
+                    # node<->leaf window flagged 18 targets "down without
+                    # an injected fault" one round after heal). Over-
+                    # marking is self-limiting — recovery pruning drops
+                    # any target the moment it is seen healthy.
+                    self.recovering |= {
+                        farm.url(i) for i in self._member_indices()}
         elif ev.kind == "preempt":
             victims = getattr(self, "_preempt_victims", set())
             farm.dead -= victims
@@ -697,6 +743,70 @@ class _Run:
                 self.dash.stop()
                 self.dash = None
 
+    def _excused_losses(self, lost: set) -> set:
+        """The subset of lost series attributable to targets down or
+        recovering from OTHER injected faults. The partition-retention
+        invariant must not claim a preempted slice's rollups as
+        partition damage (fuzzer find: preempt recovery overlapping a
+        dead-root window and a flapping cut — the frozen body still
+        lacked the victims' series, and only the partition was left
+        standing to blame). Excusal keys on the down targets' slice,
+        pod, and URL labels; series of healthy targets stay covered."""
+        farm = self.sim.farm
+        down = set(farm.dead)
+        down |= {self._idx_of(u) for u in self.recovering}
+        if not down:
+            return set()
+        slices = {f"slice-{i % farm.n_slices}" for i in down}
+        pods = {farm.pod_of(i) for i in down}
+        urls = {farm.url(i) for i in down}
+        excused = set()
+        for name, labels in lost:
+            lab = dict(labels)
+            if (lab.get("slice_name") in slices or lab.get("pod") in pods
+                    or lab.get("target") in urls):
+                excused.add((name, labels))
+        return excused
+
+    def _settle_disk(self, bound: int) -> int:
+        """Give the async shed/compaction path a bounded window to reach
+        steady state before the usage invariant reads it. A short drill
+        window (the fuzzer generates one-round disk_full events) can end
+        with the shed RECORDED but the segment rewrite still in flight —
+        measuring mid-rewrite fails a governor that is working. Returns
+        the final usage; gives up as soon as usage stops falling."""
+        usage = dir_usage_bytes(self.egress_dir)
+        for _ in range(40):
+            if usage <= bound:
+                break
+            if self.gov is None:
+                # Governor off (negative control): nothing will ever
+                # shed — measure once, fail honestly.
+                break
+            self.gov.tick()
+            if self.shipper is not None:
+                # Re-assert the held rung through the public path: the
+                # seal reclaims acked bytes the lazy rotation stranded.
+                self.shipper.set_disk_pressure(True)
+            time.sleep(0.05)
+            usage = dir_usage_bytes(self.egress_dir)
+        return usage
+
+    def _resolve_hotspot(self, ev: ScenarioEvent) -> None:
+        """Re-resolve the hot index set from the CURRENT pod mapping —
+        at window start and again every tick. An index set pinned once at
+        start silently stops mapping to ``ev.subject`` when a composed
+        churn_storm bumps ``pod_gen`` mid-window: the HBM boost lands on
+        indices whose pod label has rotated away, the subject rolls up to
+        zero, and the attributability invariant trips (the fuzzer's
+        hotspot x churn find — the old code admitted the composition was
+        unsupported "only by convention")."""
+        farm = self.sim.farm
+        farm.hot = {
+            i for i in self._member_indices()
+            if farm.pod_of(i) == ev.subject
+        }
+
     def _tick_event(self, ev: ScenarioEvent, r: int) -> None:
         """Per-round continuation for windowed events."""
         farm = self.sim.farm
@@ -712,6 +822,14 @@ class _Run:
             self.membership = self.membership[k:] + added
             farm.pod_gen += 1  # the label-churn half of the storm
             self.sim.write_targets(self.membership)
+            # Churn changes the TRUE series set (members retired, every
+            # pod label rotated): the retention baseline is stale the
+            # moment this ticks. Drop it — the next verified quiet round
+            # re-arms it — so churn's legitimate deletions can't be
+            # mis-attributed to a concurrent partition (fuzzer find:
+            # churn_storm + root<->recv cut in one round reported the
+            # rotated pods as "series lost during partition").
+            self.baseline_series = None
         elif ev.kind == "disk_full" and self.shipper is not None:
             # Keep FRESH batches landing through the window (a full extra
             # round, never a re-push of the same snapshot — identical
@@ -761,6 +879,14 @@ class _Run:
                 for ev in self.events:
                     if ev.at_round <= r < ev.end_round:
                         self._tick_event(ev, r)
+                # Hotspot resolution LAST, after every event has mutated
+                # membership/labels for this round: a churn_storm ticking
+                # after the hotspot would bump pod_gen and orphan an
+                # already-resolved hot set (event order within a round is
+                # timeline order, so the fix cannot live in _tick_event).
+                for ev in self.events:
+                    if ev.kind == "hotspot" and ev.at_round <= r < ev.end_round:
+                        self._resolve_hotspot(ev)
                 self.sim.run_round()
                 if self.shipper is not None:
                     self.shipper.on_snapshot(self.sim.root_store.current())
@@ -794,6 +920,7 @@ class _Run:
             return result
         finally:
             result["trace_ticks"] = len(self.trace)
+            result["invariants_armed"] = sorted(self.invariants_armed)
             self._close()
 
     # ---------------------------------------------------------- tick checks
@@ -817,6 +944,15 @@ class _Run:
     def _check_tick(self, r: int) -> None:
         farm = self.sim.farm
         active = self._active(r)
+        # Warm high-water of accounted memory outside injected mem
+        # windows: the reference a later mem_pressure budget is derived
+        # from. Without it, a window opening right after a root restart
+        # samples a cold cache and sets a budget the legitimate warm-up
+        # alone breaches (fuzzer find: root_restart()@2;
+        # mem_pressure()@3+2).
+        if not any(ev.kind == "mem_pressure" for ev in active):
+            self.mem_accounted_baseline = max(
+                self.mem_accounted_baseline, self._accounted_memory())
         body = self.sim.root_body()
         fams = parse_families(body)
         series = set(_family_values(body))
@@ -891,18 +1027,30 @@ class _Run:
             problems.append(
                 f"r{r}: {len(unexplained)} target(s) down without an "
                 f"injected fault: {sorted(unexplained)[:3]}")
-        self.recovering -= {t for t in self.recovering
-                            if target_up.get(t) == 1.0}
+        up_now = {t for t in self.recovering if target_up.get(t) == 1.0}
+        self.recovering -= up_now & self._recovered_once
+        self._recovered_once = up_now - self._recovered_once
         restart_active = [ev for ev in active if ev.kind == "restart_wave"]
         if restart_active:
             ev = restart_active[0]
             batch = set(self.restart_batches.get(r, ()))
-            if len(reported_down) > 2 * ev.stagger:
+            # The 2*stagger blast-radius cap is a claim about the WAVE
+            # (current batch + previous batch still recovering) — down
+            # targets attributable to a composed fault (active preempt,
+            # healed-cut recovery lag) don't count against it, but the
+            # wave's own hosts always do.
+            wave_urls = {farm.url(i)
+                         for b in self.restart_batches.values() for i in b}
+            wave_down = (reported_down
+                         - (self.recovering - wave_urls)
+                         - (injected_down - wave_urls))
+            if len(wave_down) > 2 * ev.stagger:
                 problems.append(
                     f"r{r}: restart wave (stagger {ev.stagger}) has "
-                    f"{len(reported_down)} targets down at once")
-            stray = {self._idx_of(t) for t in reported_down} - batch - {
-                self._idx_of(t) for t in self.recovering}
+                    f"{len(wave_down)} targets down at once")
+            stray = ({self._idx_of(t) for t in reported_down} - batch
+                     - {self._idx_of(t) for t in self.recovering}
+                     - {self._idx_of(t) for t in injected_down})
             if stray:
                 problems.append(
                     f"r{r}: restart wave touched targets outside its "
@@ -941,9 +1089,25 @@ class _Run:
                     f"beyond the stale-serve budget")
 
         # --- (3)+(4) series retention / oracle equality ------------------
-        partition_active = any(ev.kind == "partition" for ev in active)
-        if partition_active and self.baseline_series is not None:
+        # Retention under partition is a STALE-SERVE claim, so it scopes
+        # to the edges stale-serve covers (leaf<->root, root<->recv). A
+        # node<->leaf cut is indistinguishable from the targets dying —
+        # series withdraw BY SPECIFICATION and the attribution checks
+        # above own that contract (fuzzer find: a bare one-round
+        # node-cut tripped this as "116 series lost").
+        partition_active = any(
+            ev.kind == "partition"
+            and frozenset(ev.edge or ()) != frozenset({"node", "leaf"})
+            for ev in active)
+        node_cut_active = any(
+            ev.kind == "partition"
+            and frozenset(ev.edge or ()) == frozenset({"node", "leaf"})
+            for ev in active)
+        if (partition_active and not node_cut_active
+                and self.baseline_series is not None):
             lost = self.baseline_series - series
+            if lost:
+                lost -= self._excused_losses(lost)
             if lost:
                 problems.append(
                     f"r{r}: {len(lost)} series lost during partition: "
@@ -957,6 +1121,7 @@ class _Run:
             and r >= 2
         )
         if quiet and not reported_down:
+            self.invariants_armed.add("oracle_equality")
             oracle_problems = _compare_oracle(
                 _family_values(body), _family_values(self.sim.oracle_body())
             )
@@ -1067,12 +1232,13 @@ class _Run:
                     "quarantined": quarantined,
                 })
             if ev.kind == "disk_full":
-                usage = dir_usage_bytes(self.egress_dir)
                 # Post-shed floor: compaction's steady state is one shed
                 # segment plus ~a batch in flight — an absolute budget
                 # below one batch is unmeetable BY ANY policy, so the
                 # invariant is bounded by physics, not wishes.
                 floor = 2 * self.disk_batch_est + (12 << 10)
+                usage = self._settle_disk(
+                    max(self.disk_budget_target, floor))
                 if usage > max(self.disk_budget_target, floor):
                     problems.append(
                         f"r{r}: disk usage {usage}B still over the "
@@ -1104,14 +1270,15 @@ class _Run:
                 st = self.storm.stats()
                 peak = self.root_server.conn_stats["peak"]
                 if self.governor_on:
-                    if st["rejected"] == 0:
+                    if st["rejected"] == 0 and self.storm.conns > self.conn_cap:
                         problems.append(
-                            f"r{r}: a {self.storm.conns}-conn storm drew "
-                            f"zero 429s (admission control inert)")
-                    if peak > self.STORM_CONN_CAP:
+                            f"r{r}: a {self.storm.conns}-conn storm over "
+                            f"the {self.conn_cap}-conn cap drew zero 429s "
+                            f"(admission control inert)")
+                    if peak > self.conn_cap:
                         problems.append(
                             f"r{r}: open connections peaked at {peak} "
-                            f"over the {self.STORM_CONN_CAP} cap")
+                            f"over the {self.conn_cap} cap")
                 base = self.storm_baseline_p99
                 if self.storm_p99s and base:
                     worst = max(self.storm_p99s)
@@ -1273,6 +1440,7 @@ class _Run:
                 and all(v == 1.0 for v in target_up.values())
             )
             if leaf_up_ok and members_up:
+                self.invariants_armed.add("oracle_equality")
                 oracle_problems = _compare_oracle(
                     _family_values(body),
                     _family_values(self.sim.oracle_body()),
@@ -1472,7 +1640,31 @@ class _Run:
             for t in self.alert_eval.transitions(limit=10_000)
             if t["to"] == FIRING
         }
-        if fired != expected:
+        if self.scn.allowed_alerts is not None:
+            # Suppress-aware BOUND mode (generated timelines): required
+            # alerts must fire, nothing outside the derived envelope may
+            # fire, and nothing outside it may even have been SUPPRESSED
+            # — a rule engaging silently where the generator's model says
+            # it can't is the same disagreement as a stray firing.
+            envelope = expected | set(self.scn.allowed_alerts)
+            if not expected <= fired:
+                self.problems.append(
+                    f"alerts fired {sorted(fired)}, missing required "
+                    f"{sorted(expected - fired)} (generated-timeline "
+                    f"bound mode){tag}")
+            elif not fired <= envelope:
+                self.problems.append(
+                    f"alerts fired {sorted(fired)} outside the derived "
+                    f"envelope {sorted(envelope)} (generated-timeline "
+                    f"bound mode){tag}")
+            suppressed = set(self.alert_eval.suppressed_names())
+            if not suppressed <= envelope:
+                self.problems.append(
+                    f"alerts suppressed {sorted(suppressed)} outside the "
+                    f"derived envelope {sorted(envelope)} — the evaluator "
+                    f"engaged where the timeline model says it cannot"
+                    f"{tag}")
+        elif fired != expected:
             self.problems.append(
                 f"alerts fired {sorted(fired)}, want exactly "
                 f"{sorted(expected)} — 'the right alerts, and no "
@@ -1656,6 +1848,23 @@ class _Run:
         self.sim.close()
 
 
+def run_one(scn: Scenario, n_targets: int, shards: int, chips: int,
+            state_root: str, seed: int,
+            governor: bool = True, store: bool = True,
+            stream: bool = True,
+            alert_suppression: bool = True) -> tuple[dict, list[dict]]:
+    """One scenario on one fresh stack, returning (result, per-tick
+    trace). The fuzz harness's entrypoint: run_scenarios wraps the NAMED
+    drill set, but a generated trial is an ad-hoc Scenario object and the
+    minimizer needs the trace back for its failure artifacts — same _Run,
+    same invariants, zero drift between fuzzed and hand-written drills."""
+    run = _Run(scn, n_targets, shards, chips, state_root, seed,
+               governor=governor, store=store, stream=stream,
+               alert_suppression=alert_suppression)
+    result = run.run()
+    return result, run.trace
+
+
 def run_scenarios(names: list[str], n_targets: int, shards: int,
                   chips: int, state_root: str, seed: int,
                   governor: bool = True, store: bool = True,
@@ -1722,6 +1931,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="ad-hoc scenario: run this DSL timeline instead "
                         "of the named set (see tpu_pod_exporter.scenario "
                         "for the grammar)")
+    p.add_argument("--fuzz-replay", default="", metavar="SEED:TRIAL",
+                   help="replay one generated fuzz trial deterministically "
+                        "from its (seed, trial) coordinates alone — the "
+                        "timeline is regenerated, the stack rebuilt, and "
+                        "the same invariants asserted (delegates to "
+                        "tpu_pod_exporter.fuzz; see RUNBOOK 'Reproducing "
+                        "a fuzzer failure')")
     p.add_argument("--targets", type=int, default=120)
     p.add_argument("--shards", type=int, default=4)
     p.add_argument("--chips", type=int, default=2)
@@ -1760,6 +1976,17 @@ def main(argv: list[str] | None = None) -> int:
     p.add_argument("--log-level", default="warning")
     ns = p.parse_args(argv)
     _utils.setup_logging(ns.log_level)
+
+    if ns.fuzz_replay:
+        from tpu_pod_exporter import fuzz
+
+        try:
+            seed_s, _, trial_s = ns.fuzz_replay.partition(":")
+            seed, trial = int(seed_s), int(trial_s)
+        except ValueError:
+            p.error(f"--fuzz-replay wants SEED:TRIAL "
+                    f"(got {ns.fuzz_replay!r})")
+        return fuzz.replay(seed, trial, state_root=ns.state_root)
 
     if ns.timeline:
         adhoc = Scenario(name="adhoc", timeline=ns.timeline,

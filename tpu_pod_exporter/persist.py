@@ -1219,6 +1219,45 @@ class WalBuffer:
         self._active_bytes = 0
         self._ensure_writer()
 
+    def seal_active(self) -> int:
+        """Rotate the active segment off WITHOUT waiting for the next
+        append, then reclaim every fully-acked segment this unblocks.
+
+        Rotation is normally append-lazy, which is fine in steady state —
+        but under disk pressure with a stalled producer the active
+        segment can hold nothing but already-acked records, and those
+        bytes stay on disk until an append that may never come. The ack
+        sweep cannot touch them either (it never unlinks the active
+        segment). Sealing makes the segment sweepable now. Returns the
+        bytes reclaimed; 0 when the active segment was already empty."""
+        with self._lock:
+            if self._active_count == 0:
+                return 0
+            self._close_writer()
+            self._active_seg += 1
+            self._active_count = 0
+            self._active_bytes = 0
+            head_seg = (
+                self._entries[0][0] if self._entries else self._active_seg
+            )
+        freed = 0
+        for seg in range(self._min_seg, head_seg):
+            if seg == self._active_seg:
+                break
+            path = self._seg_path(seg)
+            try:
+                size = os.path.getsize(path)
+                os.unlink(path)
+                freed += size
+            except FileNotFoundError:
+                pass
+            except OSError:
+                break
+            self._min_seg = seg + 1
+        else:
+            self._min_seg = max(self._min_seg, head_seg)
+        return freed
+
     def _close_writer(self) -> None:
         f = self._f
         self._f = None

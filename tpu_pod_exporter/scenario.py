@@ -64,7 +64,9 @@ per-tick invariants, so new drills are one dict entry, not new code.
 
 from __future__ import annotations
 
+import random
 import re
+from collections.abc import Callable
 from dataclasses import dataclass, field
 
 EVENT_KINDS: tuple[str, ...] = (
@@ -89,6 +91,21 @@ EVENT_KINDS: tuple[str, ...] = (
 )
 
 TIERS: tuple[str, ...] = ("node", "leaf", "root", "recv")
+
+# The scenario engine's invariant families, enumerable for the fuzzer's
+# (seam × invariant) coverage ledger. Names, not code: each maps to a
+# check documented in tpu_pod_exporter.loadgen.scenario (its docstring's
+# numbered invariants plus the PR-16 alerting verdict). Declared here —
+# the typed DSL layer — so the fuzzer and tests can enumerate them
+# without importing the engine.
+INVARIANTS: tuple[str, ...] = (
+    "oracle_equality",      # quiet-round root == oracle rollup equality
+    "egress_ledger",        # exactly-once receiver ledger
+    "bounded_staleness",    # per-tier staleness budgets
+    "series_rss_leaks",     # series set + RSS bounded after churn
+    "fault_attribution",    # every fault readable from the exposition
+    "alerts_correctness",   # fired-set / suppress-aware alert verdict
+)
 
 PARTITION_MODES: tuple[str, ...] = ("symmetric", "asymmetric", "flapping")
 
@@ -356,6 +373,172 @@ def total_rounds(events: list[ScenarioEvent], settle: int = 3) -> int:
     return max(ev.end_round for ev in events) + settle
 
 
+# ---------------------------------------------------------------- rendering
+
+# Tier order for canonical edge rendering: node<->leaf, never leaf<->node.
+_TIER_RANK: dict[str, int] = {t: i for i, t in enumerate(TIERS)}
+
+
+def render_event(ev: ScenarioEvent) -> str:
+    """One event → its canonical DSL text (the alert-rule ``render_rules``
+    pattern). ``parse_event`` accepts every output: edges are tier-ordered,
+    defaulted fields (``+1`` duration, ``stagger=1``) are omitted, and the
+    kinds whose duration is derived or rejected (restart_wave, clock_step)
+    never render one — so render∘parse is idempotent and a minimized fuzz
+    reproducer commits as a plain string that replays byte-identically."""
+    if ev.kind == "partition":
+        a, b = sorted(ev.edge or ("?", "?"), key=lambda t: _TIER_RANK.get(t, 9))
+        args = f"{a}<->{b}, {ev.mode}"
+    elif ev.kind in ("preempt", "hotspot"):
+        args = ev.subject
+    elif ev.kind == "restart_wave":
+        args = str(ev.count)
+        if ev.stagger != 1:
+            args += f", stagger={ev.stagger}"
+    elif ev.kind in ("churn_storm", "scrape_storm", "dashboard_storm"):
+        args = str(ev.count)
+    elif ev.kind == "clock_step":
+        args = f"{ev.step_s:g}"
+    else:
+        args = ""
+    out = f"{ev.kind}({args})@{ev.at_round}"
+    if ev.duration != 1 and ev.kind not in ("restart_wave", "clock_step"):
+        out += f"+{ev.duration}"
+    return out
+
+
+def render_timeline(events: list[ScenarioEvent]) -> str:
+    """Event list → canonical timeline text. Events sort by
+    ``(at_round, rendered)`` — exactly the order ``parse_scenario`` yields
+    for canonical text (it sorts on ``raw``, which IS the rendered form
+    after one round trip) — so ``render_timeline(parse_scenario(s))`` is a
+    fixpoint for every valid ``s``."""
+    return "; ".join(
+        r for _at, r in sorted((e.at_round, render_event(e)) for e in events)
+    )
+
+
+# --------------------------------------------------------------- generation
+
+@dataclass(frozen=True)
+class GenBounds:
+    """The fuzzer's draw envelope. Bounds are ENGINE-facing, not
+    grammar-facing: the grammar allows unbounded counts and rounds, but a
+    generated drill must finish inside a smoke budget against a small
+    farm, so coordinates and sizes are capped here. Every value is a cap
+    on what :func:`generate_event` draws — the generated text still goes
+    through :func:`parse_event`, whose rules remain the only validity
+    oracle."""
+
+    # Window coordinates: after the engine's 2 warmup rounds, bounded so
+    # total_rounds stays smoke-sized.
+    min_round: int = 2
+    max_round: int = 8
+    max_duration: int = 3
+    # Farm-shape caps (the fuzz harness runs small fleets).
+    slices: int = 4
+    pods: int = 8
+    max_wave: int = 6
+    max_churn: int = 12
+    max_storm_conns: int = 64
+    max_dash_subs: int = 32
+    # NTP-shaped steps the clock fence must absorb, both directions.
+    clock_steps: tuple[float, ...] = (-3600.0, -45.0, 45.0, 3600.0)
+
+
+def generate_event(kind: str, rng: random.Random,
+                   bounds: GenBounds = GenBounds()) -> str:
+    """Draw one random event of ``kind`` as DSL text. Each branch mirrors
+    ``parse_event``'s argument shape; an unknown kind raises, so the
+    every-kind property test fails loudly when a new EVENT_KINDS entry
+    lands without a generator branch (the can't-silently-omit rule)."""
+    at = rng.randint(bounds.min_round, bounds.max_round)
+    dur = rng.randint(1, bounds.max_duration)
+    suffix = f"@{at}" + (f"+{dur}" if dur != 1 else "")
+    if kind == "partition":
+        edges = sorted(
+            "<->".join(sorted(e, key=lambda t: _TIER_RANK.get(t, 9)))
+            for e in PARTITION_EDGES
+        )
+        return (f"partition({rng.choice(edges)}, "
+                f"{rng.choice(PARTITION_MODES)}){suffix}")
+    if kind == "preempt":
+        return f"preempt(slice-{rng.randrange(bounds.slices)}){suffix}"
+    if kind == "restart_wave":
+        count = rng.randint(1, bounds.max_wave)
+        stagger = rng.randint(1, count)
+        opt = f", stagger={stagger}" if stagger != 1 else ""
+        return f"restart_wave({count}{opt})@{at}"
+    if kind == "churn_storm":
+        return f"churn_storm({rng.randint(2, bounds.max_churn)}){suffix}"
+    if kind == "hotspot":
+        return f"hotspot(job-{rng.randrange(bounds.pods)}){suffix}"
+    if kind == "recv_outage" or kind == "disk_full" \
+            or kind == "mem_pressure" or kind == "root_restart":
+        return f"{kind}(){suffix}"
+    if kind == "scrape_storm":
+        return f"scrape_storm({rng.randint(1, bounds.max_storm_conns)}){suffix}"
+    if kind == "clock_step":
+        return f"clock_step({rng.choice(bounds.clock_steps):g})@{at}"
+    if kind == "dashboard_storm":
+        dur = rng.randint(2, max(2, bounds.max_duration))
+        return f"dashboard_storm({rng.randint(1, bounds.max_dash_subs)})@{at}+{dur}"
+    raise ValueError(
+        f"no generator for event kind {kind!r} — every EVENT_KINDS entry "
+        f"needs a generate_event branch (the fuzzer's coverage depends on "
+        f"it)")
+
+
+def generate_timeline(
+    rng: random.Random,
+    bounds: GenBounds = GenBounds(),
+    max_events: int = 4,
+    kinds: tuple[str, ...] = EVENT_KINDS,
+    weights: dict[str, float] | None = None,
+    reject: Callable[[list[ScenarioEvent]], bool] | None = None,
+) -> str:
+    """Compose one random VALID timeline and return its canonical text.
+
+    Kinds are drawn (optionally weighted — the fuzzer biases toward dark
+    coverage pairs), each event generated, and a draw survives only if
+    the grown timeline still parses: :func:`parse_scenario` IS the
+    rejection oracle (overlap rule included), so the generator can never
+    drift from the grammar. ``reject(events) -> bool`` layers an
+    engine-level validity predicate on top (the fuzz harness passes its
+    supported-composition rule); rejected draws are redrawn, never
+    repaired, so the output distribution stays a pure function of the
+    rng stream."""
+    want = rng.randint(1, max(1, max_events))
+    chosen: list[str] = []
+    kind_list = list(kinds)
+    weight_list = (
+        [float(weights.get(k, 1.0)) for k in kind_list]
+        if weights is not None else None
+    )
+    for _attempt in range(32 * max(want, 1)):
+        if len(chosen) >= want:
+            break
+        if weight_list is not None:
+            kind = rng.choices(kind_list, weights=weight_list, k=1)[0]
+        else:
+            kind = rng.choice(kind_list)
+        cand = [*chosen, generate_event(kind, rng, bounds)]
+        try:
+            events = parse_scenario("; ".join(cand))
+        except ValueError:
+            continue
+        if reject is not None and reject(events):
+            continue
+        chosen = cand
+    if not chosen:
+        # Unreachable with sane bounds (any single partition parses), but
+        # a degenerate reject predicate must not return an empty timeline.
+        raise ValueError("generate_timeline: no valid draw survived the "
+                         "rejection oracle — bounds or reject predicate "
+                         "exclude every single-event timeline")
+    return render_timeline(parse_scenario("; ".join(chosen)))
+
+
 @dataclass(frozen=True)
 class Scenario:
     """One named drill: a timeline plus what the engine should expect."""
@@ -390,6 +573,15 @@ class Scenario:
     # control: suppression is disabled, the suppressed alert fires too,
     # and the fired-set assertion must FAIL.
     expected_alerts: tuple[str, ...] | None = None
+    # Suppress-aware BOUND mode for GENERATED timelines (the fuzzer): a
+    # random composition can make an allowed-but-not-required alert fire
+    # legitimately (a symmetric cut leaves no twin to vouch, so
+    # TpuRootLeafDown is correct, not a violation). When non-None the
+    # finish asserts expected ⊆ fired ⊆ expected ∪ allowed instead of
+    # exact equality — and anything the evaluator SUPPRESSED must also
+    # sit inside that envelope. None keeps the hand-written drills'
+    # exact-set assertion (strictly stronger; never weakened by fuzzing).
+    allowed_alerts: tuple[str, ...] | None = None
 
     def events(self) -> list[ScenarioEvent]:
         return parse_scenario(self.timeline)
@@ -611,6 +803,41 @@ SCENARIOS: dict[str, Scenario] = {
             settle_rounds=4,
             uses_store=True,
             expected_alerts=("TpuRootLeafPartitioned",),
+        ),
+        Scenario(
+            name="fuzz_root_restart_egress",
+            timeline="root_restart()@2",
+            description=(
+                "Fuzzer-found regression (minimized by ddmin from a "
+                "4-event composite; replay: fuzz seed 1 trial 7): a dead "
+                "root freezes the snapshot, and with the engine's "
+                "interval_s=0 shipper the heartbeat ride-along re-framed "
+                "the SAME poll instant every round — identical (series, "
+                "timestamp) samples under fresh seqs, duplicate samples "
+                "in the exactly-once ledger. The shipper now refuses to "
+                "frame a poll instant twice (_same_poll_instant); this "
+                "drill pins it. The root-process seam was never composed "
+                "with an armed egress ledger by any hand-written drill — "
+                "the coverage matrix's first dark-pair catch."
+            ),
+            settle_rounds=3,
+        ),
+        Scenario(
+            name="fuzz_hotspot_churn",
+            timeline="hotspot(job-3)@3+4; churn_storm(8)@4+2",
+            description=(
+                "Fuzzer-found regression (surfaced by generated "
+                "hotspot+churn overlaps; minimized by hand — the "
+                "campaign artifact predates the coverage ledger): a "
+                "churn storm bumping pod_gen "
+                "mid-hotspot rotated every pod label, orphaning the hot "
+                "index set resolved at window start — the subject rolled "
+                "up to zero and attributability collapsed (the old code "
+                "admitted the composition was unsupported 'only by "
+                "convention'). The engine now re-resolves the hot set "
+                "after ALL events have mutated membership each round."
+            ),
+            settle_rounds=3,
         ),
     )
 }
