@@ -1,5 +1,5 @@
 # Developer entry points.
-.PHONY: test lint typecheck lint-demo lock-graph witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo fuzz-smoke pressure-demo store-demo dashboard-demo alert-demo clean
+.PHONY: test lint typecheck lint-demo lock-graph witness-check fork-inventory loop-witness-check native proto bench history-demo chaos-demo trace-demo trace-overhead restart-demo persist-fsync-check persist-overhead fleet-query-demo shard-demo egress-demo egress-drain-check scenario-demo fuzz-smoke pressure-demo store-demo dashboard-demo alert-demo clean
 
 test:
 	python -m pytest tests/ -q
@@ -54,6 +54,24 @@ witness-check:
 	TPE_LOCK_WITNESS=1 TPE_LOCK_WITNESS_OUT=lock-witness.json \
 		python -m pytest tests/ -q -m 'not slow'
 	python -m tpu_pod_exporter.analysis --check-witness lock-witness.json
+
+# Regenerate the REVIEWED pre-fork resource inventory (README
+# "Execution-context contracts"). Every thread-spawn, lock, and kernel-
+# object creation site that may be live when the multi-core plane forks;
+# CI diffs it, so a change here is a reviewable pre-fork-surface change.
+fork-inventory:
+	python -m tpu_pod_exporter.analysis \
+		--fork-inventory deploy/fork-inventory.json
+
+# Run tier-1 under the runtime loop-stall witness and cross-check every
+# loop-executed callback against the static loop-role model (the CI
+# `concurrency` leg; deploy/RUNBOOK.md "Execution-context contracts").
+# Fails on an inline stall (conftest exit 4) or a callback the static
+# model cannot explain.
+loop-witness-check:
+	TPE_LOOP_WITNESS=1 TPE_LOOP_WITNESS_OUT=loop-witness.json \
+		python -m pytest tests/ -q -m 'not slow'
+	python -m tpu_pod_exporter.analysis --check-loop-witness loop-witness.json
 
 # Replay the round-5 real-hardware trace through the history flight
 # recorder and print what /api/v1/window_stats would answer — the offline

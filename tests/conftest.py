@@ -30,6 +30,13 @@ from tpu_pod_exporter.analysis import witness as _lock_witness  # noqa: E402
 
 _WITNESS = _lock_witness.install_from_env()
 
+# Loop witness (TPE_LOOP_WITNESS=1): hooks server.LOOP_PROBE so every
+# callback the event loop runs inline is timed; any stall over
+# TPE_LOOP_WITNESS_STALL_MS fails the session (exit 4). Installed after
+# the lock witness on purpose — this one imports the server module, and
+# the lock factories must already be patched when that import runs.
+_LOOP_WITNESS = _lock_witness.install_loop_from_env()
+
 import pytest  # noqa: E402
 
 
@@ -94,34 +101,49 @@ def pytest_terminal_summary(terminalreporter, exitstatus, config):
     """Witness session report: edge/hold summary, inversions verbatim.
     The edge dump is written either way so CI can cross-check it against
     the static lock-order graph."""
-    if _WITNESS is None:
-        return
-    out = os.environ.get("TPE_LOCK_WITNESS_OUT", "lock-witness.json")
-    doc = _WITNESS.dump(out)
     tr = terminalreporter
-    tr.write_sep("-", "lock witness")
-    meta = doc["meta"]
-    tr.write_line(
-        f"lock witness: {meta['locks']} lock site(s), "
-        f"{meta['acquisitions']} acquisition(s), {meta['edges']} order "
-        f"edge(s); dump -> {out}")
-    for inv in doc["inversions"]:
-        tr.write_line(f"INVERSION: {inv['detail']}", red=True)
-    if doc["long_holds"]:
-        worst = max(doc["long_holds"], key=lambda h: h["held_ms"])
+    if _WITNESS is not None:
+        out = os.environ.get("TPE_LOCK_WITNESS_OUT", "lock-witness.json")
+        doc = _WITNESS.dump(out)
+        tr.write_sep("-", "lock witness")
+        meta = doc["meta"]
         tr.write_line(
-            f"{len(doc['long_holds'])} hold(s) over "
-            f"{meta['hold_warn_ms']} ms (worst: {worst['site']} "
-            f"{worst['held_ms']} ms on {worst['thread']}) — review, "
-            f"not a gate")
+            f"lock witness: {meta['locks']} lock site(s), "
+            f"{meta['acquisitions']} acquisition(s), {meta['edges']} order "
+            f"edge(s); dump -> {out}")
+        for inv in doc["inversions"]:
+            tr.write_line(f"INVERSION: {inv['detail']}", red=True)
+        if doc["long_holds"]:
+            worst = max(doc["long_holds"], key=lambda h: h["held_ms"])
+            tr.write_line(
+                f"{len(doc['long_holds'])} hold(s) over "
+                f"{meta['hold_warn_ms']} ms (worst: {worst['site']} "
+                f"{worst['held_ms']} ms on {worst['thread']}) — review, "
+                f"not a gate")
+    if _LOOP_WITNESS is not None:
+        out = os.environ.get("TPE_LOOP_WITNESS_OUT", "loop-witness.json")
+        doc = _LOOP_WITNESS.dump(out)
+        tr.write_sep("-", "loop witness")
+        meta = doc["meta"]
+        tr.write_line(
+            f"loop witness: {meta['callbacks']} distinct inline "
+            f"callback(s) timed, {meta['stalls']} stall(s) over "
+            f"{meta['threshold_ms']} ms; dump -> {out}")
+        for stall in doc["stalls"]:
+            tr.write_line(
+                f"LOOP STALL: {stall['qualname']} ({stall['kind']}) ran "
+                f"{stall['ms']} ms inline on the event loop", red=True)
 
 
 def pytest_sessionfinish(session, exitstatus):
     """A witnessed lock-order inversion fails the run even if every test
     passed — the interleaving that deadlocks may just not have happened
-    this time."""
+    this time. A loop stall likewise: one stalled inline callback parks
+    every connection, whether or not an assertion noticed."""
     if _WITNESS is not None and _WITNESS.inversions:
         session.exitstatus = 3
+    if _LOOP_WITNESS is not None and _LOOP_WITNESS.stalls:
+        session.exitstatus = 4
 
 
 @pytest.fixture

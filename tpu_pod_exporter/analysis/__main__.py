@@ -6,15 +6,19 @@ rule: message``), 2 on operational errors (missing schema, bad root).
 
 ``--demo`` seeds deliberate violations into a temp copy of the package —
 a lock-scoped ``json.dumps``, an unregistered metric name, a lock-order
-inversion pair, and a wrong-thread WAL cursor move — and exits 0 only if
-ALL four rule families catch their seed (the lint analog of ``make
+inversion pair, a wrong-thread WAL cursor move, an inline ``time.sleep``
+on the event loop, a raw ``open("w")`` on a cursor path plus a second
+cursor-mover thread, and a stray ``os.fork`` — and exits 0 only if ALL
+seven rule families catch their seed (the lint analog of ``make
 chaos-demo``).
 
 ``--lock-graph``/``--lock-graph-dot`` render the concurrency model's
 acquisition-order graph (the committed ``deploy/lock-graph.json``
-artifact); ``--check-witness`` cross-checks a runtime witness edge dump
-(``tests/conftest.py`` under ``TPE_LOCK_WITNESS=1``) against the static
-model.
+artifact); ``--fork-inventory`` renders the pre-fork resource inventory
+(the committed ``deploy/fork-inventory.json`` artifact);
+``--check-witness``/``--check-loop-witness`` cross-check runtime witness
+dumps (``tests/conftest.py`` under ``TPE_LOCK_WITNESS=1`` /
+``TPE_LOOP_WITNESS=1``) against the static model.
 """
 
 from __future__ import annotations
@@ -45,6 +49,13 @@ _DEMO_EXPECTED = (
     ("lock-ownership", "a 'tpu-demo-wrong-thread' thread calling "
                        "WalBuffer.ack() (cursor move off the owner "
                        "thread)"),
+    ("loop-blocking", "a call_soon()-posted callback doing time.sleep() "
+                      "inline on the event loop"),
+    ("durability-ordering", "raw open(.., 'w') on a cursor.json path, "
+                            "plus a second cursor-mover thread on a "
+                            "WalBuffer"),
+    ("fork-safety", "an os.fork() outside any sanctioned pre-fork "
+                    "entry point"),
 )
 
 
@@ -109,6 +120,52 @@ def _run_demo(root: str) -> int:
                 "\n"
                 "    def _move(self) -> None:\n"
                 "        self._buf.ack()\n"
+                "\n\n"
+                "def _lint_demo_raw_cursor_write(root: str) -> None:\n"
+                "    # Seeded by `exporter-lint --demo`: a raw open('w')\n"
+                "    # on a durability state path — bypasses the atomic\n"
+                "    # write-temp/fsync/rename discipline.\n"
+                "    with open(root + '/cursor.json', 'w') as f:\n"
+                "        f.write('{}')\n"
+                "\n\n"
+                "def _lint_demo_fork() -> None:\n"
+                "    # Seeded by `exporter-lint --demo`: fork outside any\n"
+                "    # sanctioned pre-fork entry point.\n"
+                "    os.fork()\n"
+                "\n\n"
+                "class _LintDemoDualMover:\n"
+                "    # Seeded by `exporter-lint --demo`: TWO threads moving\n"
+                "    # one WalBuffer cursor. mover-a is the declared owner\n"
+                "    # (demo CursorMoverRule in analysis/execcontext.py);\n"
+                "    # mover-b is the second-mover violation.\n"
+                "    def __init__(self) -> None:\n"
+                "        self._wal = WalBuffer('/tmp/lint-demo-dual-wal')\n"
+                "        self._ta = threading.Thread(\n"
+                "            target=self._move_a,\n"
+                "            name='tpu-demo-mover-a', daemon=True,\n"
+                "        )\n"
+                "        self._tb = threading.Thread(\n"
+                "            target=self._move_b,\n"
+                "            name='tpu-demo-mover-b', daemon=True,\n"
+                "        )\n"
+                "\n"
+                "    def _move_a(self) -> None:\n"
+                "        self._wal.ack()\n"
+                "\n"
+                "    def _move_b(self) -> None:\n"
+                "        self._wal.trim_to_bytes(0)\n"
+            )
+        with open(os.path.join(pkg, "server.py"), "a") as f:
+            f.write(
+                "\n\n"
+                "def _lint_demo_loop_blocking() -> None:\n"
+                "    # Seeded by `exporter-lint --demo`: time.sleep inline\n"
+                "    # on the event loop (posted via call_soon below) —\n"
+                "    # one stalled callback parks every connection.\n"
+                "    time.sleep(0.5)\n"
+                "\n\n"
+                "def _lint_demo_register(loop) -> None:\n"
+                "    loop.call_soon(_lint_demo_loop_blocking)\n"
             )
         print("seeded into a temp copy of the package:")
         for rule, what in _DEMO_EXPECTED:
@@ -117,7 +174,8 @@ def _run_demo(root: str) -> int:
         findings = [
             d for d in lint_package(tmp)
             if d.path in ("tpu_pod_exporter/collector.py",
-                          "tpu_pod_exporter/persist.py")
+                          "tpu_pod_exporter/persist.py",
+                          "tpu_pod_exporter/server.py")
         ]
         caught = set()
         for d in findings:
@@ -168,6 +226,15 @@ def main(argv: list[str] | None = None) -> int:
                    help="cross-check a runtime lock-witness edge dump "
                         "(tier-1 under TPE_LOCK_WITNESS=1) against the "
                         "static model; non-zero on any unexplained edge")
+    p.add_argument("--fork-inventory", metavar="PATH", default=None,
+                   help="write the pre-fork resource inventory (threads/"
+                        "locks/kernel objects; the reviewed "
+                        "deploy/fork-inventory.json artifact) and exit")
+    p.add_argument("--check-loop-witness", metavar="DUMP", default=None,
+                   help="cross-check a runtime loop-witness dump (tier-1 "
+                        "under TPE_LOOP_WITNESS=1) against the static "
+                        "loop-role model; non-zero on any stall or any "
+                        "loop-executed callback the model cannot explain")
     ns = p.parse_args(argv)
 
     if ns.list_rules:
@@ -184,7 +251,8 @@ def main(argv: list[str] | None = None) -> int:
     if ns.demo:
         return _run_demo(root)
 
-    if ns.lock_graph or ns.lock_graph_dot or ns.check_witness:
+    if (ns.lock_graph or ns.lock_graph_dot or ns.check_witness
+            or ns.fork_inventory or ns.check_loop_witness):
         from tpu_pod_exporter.analysis import concurrency
         model = concurrency.get_model(build_context(root))
         if ns.lock_graph:
@@ -219,6 +287,39 @@ def main(argv: list[str] | None = None) -> int:
                 return 1
             print("exporter-lint: witness cross-check OK — every "
                   "witnessed edge is explained by the static model")
+        if ns.fork_inventory:
+            from tpu_pod_exporter.analysis import execcontext
+            doc = execcontext.fork_inventory(model)
+            with open(ns.fork_inventory, "w", encoding="utf-8") as f:
+                json.dump(doc, f, indent=1, sort_keys=True)
+                f.write("\n")
+            print(f"wrote {len(doc['threads'])} thread(s), "
+                  f"{len(doc['locks'])} lock(s), "
+                  f"{len(doc['kernel_objects'])} kernel object(s) to "
+                  f"{ns.fork_inventory}")
+        if ns.check_loop_witness:
+            from tpu_pod_exporter.analysis import execcontext
+            from tpu_pod_exporter.analysis import witness as witness_mod
+            try:
+                dump = witness_mod.load_dump(ns.check_loop_witness)
+            except (OSError, ValueError) as e:
+                print(f"exporter-lint: cannot read loop-witness dump: {e}",
+                      file=sys.stderr)
+                return 2
+            problems = execcontext.cross_check_loop(model, dump)
+            meta = dump.get("meta", {})
+            print(f"loop-witness dump: {meta.get('callbacks', '?')} "
+                  f"callback(s), {meta.get('stalls', '?')} stall(s) over "
+                  f"{meta.get('threshold_ms', '?')} ms")
+            for prob in problems:
+                print(f"CROSS-CHECK: {prob}")
+            if problems:
+                print(f"exporter-lint: loop-witness cross-check FAILED "
+                      f"({len(problems)} problem(s))")
+                return 1
+            print("exporter-lint: loop-witness cross-check OK — zero "
+                  "stalls; every loop-executed callback is "
+                  "loop-role-tagged in the static model")
         return 0
 
     findings = lint_package(root)

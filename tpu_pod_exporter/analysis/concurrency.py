@@ -117,6 +117,26 @@ CALLBACK_ROLES: tuple[CallbackRole, ...] = (
         "(server._WorkerPool._run)",
     ),
     CallbackRole(
+        "server._EventLoopServer.call_soon", (0,),
+        ("tpu-exporter-http",),
+        "posted callbacks run inline on the selectors-loop thread "
+        "(server._EventLoopServer._run_pending) — the loop-blocking "
+        "rule's role seed for worker->loop handoffs",
+    ),
+    CallbackRole(
+        "server._EventLoopServer.call_later", (1,),
+        ("tpu-exporter-http",),
+        "timer callbacks fire inline on the loop thread "
+        "(server._EventLoopServer._run_timers)",
+    ),
+    CallbackRole(
+        "server._EventLoopServer._invoke", (1,),
+        ("tpu-exporter-http",),
+        "the loop-dispatch choke point: everything handed to it runs "
+        "inline on the loop thread (the loop-stall witness times the "
+        "same seam at runtime)",
+    ),
+    CallbackRole(
         "supervisor.SourceSupervisor._submit", (0,),
         ("tpu-sup-*",),
         "supervised phase callables execute on the per-source fenced "
@@ -885,7 +905,28 @@ class _Builder:
                 self._register_nested(child, n.body)
                 self._walk_events(child, n.body, frozenset())
                 continue
-            if isinstance(n, (ast.ClassDef, ast.Lambda)):
+            if isinstance(n, ast.Lambda):
+                # Lambdas are functions too: a ``lambda: self._respond(..)``
+                # handed through an UNRESOLVED registrar (hub.subscribe's
+                # writer=) still contains call_soon registrations whose
+                # callbacks the loop runs — skipping the body here would
+                # leave those callbacks role-less, and the loop-stall
+                # witness would observe functions the static model cannot
+                # explain. Same identity scheme as _callable_arg_targets.
+                fq = f"{fi.qualname}.<lambda@{n.lineno}>"
+                if fq not in self.m.functions:
+                    child = _FuncInfo(
+                        qualname=fq, relpath=fi.relpath, mod=fi.mod,
+                        cls=fi.cls, node=n)
+                    child.local_types = dict(fi.local_types)
+                    child.local_locks = dict(fi.local_locks)
+                    child.local_funcs = fi.local_funcs
+                    self.m.functions[fq] = child
+                    body_stmt: list[ast.stmt] = [ast.Expr(value=n.body)]
+                    self._register_nested(child, body_stmt)
+                    self._walk_events(child, body_stmt, frozenset())
+                continue
+            if isinstance(n, ast.ClassDef):
                 continue
             stack.extend(ast.iter_child_nodes(n))
 

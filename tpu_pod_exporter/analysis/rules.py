@@ -544,6 +544,26 @@ def _check_lock_io_chain(ctx: "LintContext") -> list[Diagnostic]:
     return concurrency.check_lock_io_chain(ctx)
 
 
+# The three execution-context rule families (loop-blocking,
+# durability-ordering, fork-safety) build a derived pass over the same
+# concurrency model; same lazy-import discipline.
+
+
+def _check_loop_blocking(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import execcontext
+    return execcontext.check_loop_blocking(ctx)
+
+
+def _check_durability_ordering(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import execcontext
+    return execcontext.check_durability_ordering(ctx)
+
+
+def _check_fork_safety(ctx: "LintContext") -> list[Diagnostic]:
+    from tpu_pod_exporter.analysis import execcontext
+    return execcontext.check_fork_safety(ctx)
+
+
 # ------------------------------------------------------------------- registry
 
 ALL_RULES: tuple[Rule, ...] = (
@@ -627,6 +647,30 @@ ALL_RULES: tuple[Rule, ...] = (
         "serialization, compression, or logging (lock-io, "
         "interprocedural).",
         check_tree=_check_lock_io_chain,
+    ),
+    Rule(
+        "loop-blocking", ERROR,
+        "No function running inline on the event loop (role "
+        "tpu-exporter-http, propagated through call_soon/call_later/"
+        "_invoke) may block: file I/O, time.sleep, compression, "
+        "serialization, or locks whose holders block "
+        "(analysis/execcontext.py).",
+        check_tree=_check_loop_blocking,
+    ),
+    Rule(
+        "durability-ordering", ERROR,
+        "State files go through persist.atomic_write; cursor movers are "
+        "fsync-reachable before return; each WalBuffer cursor has "
+        "exactly one declared mover role (analysis/execcontext.py).",
+        check_tree=_check_durability_ordering,
+    ),
+    Rule(
+        "fork-safety", ERROR,
+        "No os.fork/multiprocessing outside a sanctioned pre-fork entry; "
+        "no import-time thread/fd creation; the pre-fork resource "
+        "inventory is committed as deploy/fork-inventory.json "
+        "(analysis/execcontext.py).",
+        check_tree=_check_fork_safety,
     ),
 )
 

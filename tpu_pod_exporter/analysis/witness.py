@@ -29,6 +29,11 @@ edge dump lands at ``TPE_LOCK_WITNESS_OUT`` (default
 
 The witness's own bookkeeping uses a raw ``_thread`` lock allocated
 before any patching, so it can never observe (or deadlock) itself.
+
+This module also hosts :class:`LoopWitness` — the runtime half of the
+loop-blocking contract (``TPE_LOOP_WITNESS=1``): it hooks the event
+loop's dispatch choke point (``server.LOOP_PROBE``) and times every
+callback the loop runs inline, failing the session on stalls.
 """
 
 from __future__ import annotations
@@ -343,8 +348,137 @@ def load_dump(path: str) -> dict:
     return doc
 
 
+# --------------------------------------------------------- loop witness
+
+
+class LoopWitness:
+    """Runtime loop-stall witness — the dynamic half of the loop-blocking
+    contract (static half: analysis/execcontext.py).
+
+    Hooks ``server.LOOP_PROBE``, the dispatch choke point every callback
+    the event loop runs inline passes through (``_invoke``: selector
+    events, ``call_soon`` posts, timers). Per callback it aggregates
+    count / max / total wall time keyed by the function's STATIC identity
+    (module, ``__qualname__``, first line — the same identity
+    :func:`execcontext.cross_check_loop` maps onto the model), and
+    records a **stall** for any inline callback exceeding the threshold
+    (``TPE_LOOP_WITNESS_STALL_MS``, default 500 ms — inline work is
+    microseconds-scale; half a second inline means the contract is
+    broken, not that the runner is slow). Unlike the lock witness's
+    long-hold warn list, stalls FAIL the session: a stalled loop is
+    user-visible (every connection parks), so CI treats it like an
+    inversion.
+
+    Installed from ``tests/conftest.py`` under ``TPE_LOOP_WITNESS=1``;
+    the dump lands at ``TPE_LOOP_WITNESS_OUT`` (default
+    ``loop-witness.json``) and ``python -m tpu_pod_exporter.analysis
+    --check-loop-witness <dump>`` cross-checks every witnessed callback
+    against the static model's loop-role tags."""
+
+    def __init__(self, stall_ms: float | None = None) -> None:
+        if stall_ms is None:
+            stall_ms = float(
+                os.environ.get("TPE_LOOP_WITNESS_STALL_MS", "500"))
+        self.stall_ms = stall_ms
+        self._mutex = _thread.allocate_lock()
+        self._installed = False
+        self._saved: Any = None
+        # (module, qualname, line) -> {"kinds", "count", "max_ms", "total_ms"}
+        self.callbacks: dict[tuple[str, str, int], dict] = {}
+        self.stalls: list[dict] = []
+
+    def install(self) -> "LoopWitness":
+        if not self._installed:
+            # Deferred import: the analyzer side of this module must stay
+            # importable without pulling the server in (exporter-lint
+            # never imports checked code — only the RUNTIME witness does).
+            from tpu_pod_exporter import server
+            self._saved = server.LOOP_PROBE
+            server.LOOP_PROBE = self._observe
+            self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        if self._installed:
+            from tpu_pod_exporter import server
+            server.LOOP_PROBE = self._saved
+            self._installed = False
+
+    def __enter__(self) -> "LoopWitness":
+        return self.install()
+
+    def __exit__(self, *exc: object) -> None:
+        self.uninstall()
+
+    @staticmethod
+    def _identity(fn: Any) -> tuple[str, str, int]:
+        """Static identity of a dispatched callable: unwrap partials and
+        bound methods down to the code object the model parsed."""
+        seen = 0
+        while hasattr(fn, "func") and seen < 8:  # functools.partial chain
+            fn = fn.func
+            seen += 1
+        fn = getattr(fn, "__func__", fn)  # bound method -> function
+        code = getattr(fn, "__code__", None)
+        module = getattr(fn, "__module__", "") or ""
+        qualname = getattr(fn, "__qualname__", repr(fn))
+        line = code.co_firstlineno if code is not None else 0
+        return (module, qualname, line)
+
+    def _observe(self, kind: str, fn: Any, dur_s: float) -> None:
+        module, qualname, line = self._identity(fn)
+        ms = dur_s * 1000.0
+        with self._mutex:
+            rec = self.callbacks.setdefault((module, qualname, line), {
+                "kinds": set(), "count": 0, "max_ms": 0.0, "total_ms": 0.0,
+            })
+            rec["kinds"].add(kind)
+            rec["count"] += 1
+            rec["total_ms"] += ms
+            if ms > rec["max_ms"]:
+                rec["max_ms"] = ms
+            if ms > self.stall_ms and len(self.stalls) < _MAX_LONG_HOLDS:
+                self.stalls.append({
+                    "module": module, "qualname": qualname, "line": line,
+                    "kind": kind, "ms": round(ms, 3),
+                })
+
+    def report(self) -> dict:
+        with self._mutex:
+            return {
+                "meta": {
+                    "kind": "loop-witness",
+                    "threshold_ms": self.stall_ms,
+                    "callbacks": len(self.callbacks),
+                    "stalls": len(self.stalls),
+                },
+                "callbacks": [
+                    {
+                        "module": module, "qualname": qualname, "line": line,
+                        "kinds": sorted(rec["kinds"]),
+                        "count": rec["count"],
+                        "max_ms": round(rec["max_ms"], 3),
+                        "total_ms": round(rec["total_ms"], 3),
+                    }
+                    for (module, qualname, line), rec
+                    in sorted(self.callbacks.items())
+                ],
+                "stalls": list(self.stalls),
+            }
+
+    def dump(self, path: str) -> dict:
+        doc = self.report()
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f, indent=1, sort_keys=True)
+            f.write("\n")
+        os.replace(tmp, path)
+        return doc
+
+
 # Process-global instance management for the conftest hook.
 _active: LockWitness | None = None
+_active_loop: LoopWitness | None = None
 
 
 def install_from_env() -> LockWitness | None:
@@ -360,3 +494,20 @@ def install_from_env() -> LockWitness | None:
 
 def active() -> LockWitness | None:
     return _active
+
+
+def install_loop_from_env() -> LoopWitness | None:
+    """Install the loop witness when ``TPE_LOOP_WITNESS=1`` (idempotent).
+    Unlike :func:`install_from_env` this imports the server module, so it
+    must run AFTER the lock witness is live (lock wrapping happens at
+    lock-creation time; probe hooking is just a module-global swap)."""
+    global _active_loop
+    if os.environ.get("TPE_LOOP_WITNESS", "") not in ("1", "true", "yes"):
+        return None
+    if _active_loop is None:
+        _active_loop = LoopWitness().install()
+    return _active_loop
+
+
+def loop_active() -> LoopWitness | None:
+    return _active_loop

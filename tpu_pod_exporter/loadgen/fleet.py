@@ -876,10 +876,10 @@ class _ShardSim:
     def write_targets(self, targets) -> None:
         import os
 
-        tmp = self.targets_file + ".tmp"
-        with open(tmp, "w", encoding="utf-8") as f:
-            f.write("\n".join(targets) + "\n")
-        os.replace(tmp, self.targets_file)
+        from tpu_pod_exporter.persist import atomic_write
+
+        atomic_write(
+            self.targets_file, ("\n".join(targets) + "\n").encode("utf-8"))
         # mtime granularity on some filesystems is 1s; the reload check is
         # mtime-based, and demo rounds are subsecond — force a visible bump.
         st = os.stat(self.targets_file)

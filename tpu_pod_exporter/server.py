@@ -353,9 +353,9 @@ def _json_response(code: int, obj: Any) -> _Response:
         # exactly during the forensics these endpoints serve. Backends
         # CAN report NaN samples (format_value supports them), so the
         # fallback path maps non-finite values to null instead of 500ing.
-        body = json.dumps(obj, allow_nan=False).encode()
+        body = json.dumps(obj, allow_nan=False).encode()  # lint: disable=loop-blocking(probe payloads only: readyz/healthz/debug docs are a few hundred bytes, microseconds to encode — the metrics exposition never comes through here)
     except ValueError:
-        body = json.dumps(_json_sanitize(obj)).encode()
+        body = json.dumps(_json_sanitize(obj)).encode()  # lint: disable=loop-blocking(same probe-sized payload as the line above, non-finite fallback)
     return _Response(code, [("Content-Type", "application/json")], body)
 
 
@@ -554,6 +554,14 @@ class _CompatHandle:
         self.RequestHandlerClass = state
 
 
+# Loop-dispatch probe seam. analysis/witness.py's LoopWitness sets this
+# under TPE_LOOP_WITNESS=1 to time every callback the loop runs inline
+# (the runtime half of the loop-blocking contract; the static half never
+# imports this module). None — the default — keeps dispatch at one global
+# read plus a branch.
+LOOP_PROBE: "Callable[[str, Callable[..., None], float], None] | None" = None
+
+
 class _EventLoopServer:
     """The selector loop plus request routing. Single-threaded: every
     socket operation happens on the loop thread; workers communicate back
@@ -608,17 +616,17 @@ class _EventLoopServer:
                     timeout = max(0.0, self._timers[0][0] - time.monotonic())
                 for key, mask in self._sel.select(timeout):
                     if key.fileobj is self._lsock:
-                        self._accept()
+                        self._invoke("accept", self._accept)
                     elif key.fileobj is self._wake_r:
-                        self._drain_wake()
+                        self._invoke("wake", self._drain_wake)
                     else:
                         conn: _Conn = key.data
                         if conn.closed:
                             continue
                         if mask & selectors.EVENT_WRITE:
-                            self._try_write(conn)
+                            self._invoke("write", self._try_write, conn)
                         if mask & selectors.EVENT_READ and not conn.closed:
-                            self._on_readable(conn)
+                            self._invoke("read", self._on_readable, conn)
                 self._run_pending()
                 self._run_timers()
         finally:
@@ -640,6 +648,22 @@ class _EventLoopServer:
             self._wake_w.send(b"\x00")
         except (BlockingIOError, OSError):
             pass  # a wake is already pending (or the loop is gone)
+
+    def _invoke(self, kind: str, fn: Callable[..., None],
+                *args: Any) -> None:
+        """Loop-dispatch choke point: every callback the loop runs inline
+        passes through here, so the loop-stall witness can time it and the
+        static analyzer can tag the ``fn`` argument with the loop role
+        (CALLBACK_ROLES in analysis/concurrency.py)."""
+        probe = LOOP_PROBE
+        if probe is None:
+            fn(*args)
+            return
+        t0 = time.monotonic()
+        try:
+            fn(*args)
+        finally:
+            probe(kind, fn, time.monotonic() - t0)
 
     def call_soon(self, fn: Callable[[], None]) -> None:
         """Thread-safe: schedule ``fn`` on the loop thread."""
@@ -668,7 +692,7 @@ class _EventLoopServer:
                     return
                 fn = self._pending.popleft()
             try:
-                fn()
+                self._invoke("pending", fn)
             except Exception:  # noqa: BLE001 — one callback must not kill the loop
                 log.exception("loop callback failed")
 
@@ -677,7 +701,7 @@ class _EventLoopServer:
         while self._timers and self._timers[0][0] <= now:
             _, _, fn = heapq.heappop(self._timers)
             try:
-                fn()
+                self._invoke("timer", fn)
             except Exception:  # noqa: BLE001 — one timer must not kill the loop
                 log.exception("loop timer failed")
 
